@@ -1,0 +1,29 @@
+"""Test harness config: run JAX on CPU with 8 fake devices.
+
+SURVEY.md §4.2 item 3 — multi-chip without a cluster:
+``xla_force_host_platform_device_count=8`` fakes 8 devices so
+shard_map/collective tests run anywhere, replacing the reference's
+"just need a local redis-server" property.
+
+Note: this image's sitecustomize registers the axon TPU plugin and
+force-sets ``jax_platforms="axon,cpu"`` via ``jax.config.update`` (which
+overrides the JAX_PLATFORMS env var), so we must update the config back to
+"cpu" *after* importing jax but *before* any backend initializes —
+otherwise every test process tries to grab the single TPU tunnel.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,111 @@
+"""Counting bloom filter tests (BASELINE config 4: 4-bit counters,
+insert/delete/query mix, exercises scatter-add)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpubloom import CountingBloomFilter, CPUBloomFilter, FilterConfig
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return FilterConfig(m=1 << 20, k=5, key_len=16, counting=True)
+
+
+def _rand_keys(n, rng, nbytes=16):
+    return [rng.bytes(nbytes) for _ in range(n)]
+
+
+def test_insert_delete_query_mix(cfg):
+    rng = np.random.default_rng(0)
+    keep = _rand_keys(500, rng)
+    drop = _rand_keys(500, rng)
+    f = CountingBloomFilter(cfg)
+    f.insert_batch(keep + drop)
+    assert f.include_batch(keep + drop).all()
+    f.delete_batch(drop)
+    assert f.include_batch(keep).all(), "deleting other keys must not evict"
+    # deleted keys are (almost surely) gone at this load factor
+    assert f.include_batch(drop).mean() < 0.05
+
+
+def test_parity_vs_oracle(cfg):
+    rng = np.random.default_rng(1)
+    keys = _rand_keys(400, rng)
+    dup = keys[:50]  # duplicates within one batch
+    f, o = CountingBloomFilter(cfg), CPUBloomFilter(cfg)
+    for batch in (keys + dup, dup):
+        f.insert_batch(batch)
+        o.insert_batch(batch)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    f.delete_batch(dup + dup[:10])
+    o.delete_batch(dup + dup[:10])
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    probe = keys + _rand_keys(400, rng)
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
+
+
+def test_saturation_at_15(cfg):
+    f, o = CountingBloomFilter(cfg), CPUBloomFilter(cfg)
+    key = [b"hot-key"]
+    for _ in range(20):  # 20 > 15: counters must saturate, not wrap
+        f.insert_batch(key)
+        o.insert_batch(key)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    assert f.include(b"hot-key")
+    vals = np.asarray(f.words)
+    nibbles = np.concatenate([(vals >> (4 * i)) & 15 for i in range(8)])
+    assert nibbles.max() == 15
+
+
+def test_saturated_batch_single_shot(cfg):
+    # 20 copies of the same key in ONE batch — multiplicity clamps in-kernel.
+    f, o = CountingBloomFilter(cfg), CPUBloomFilter(cfg)
+    f.insert_batch([b"dup"] * 20)
+    o.insert_batch([b"dup"] * 20)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+
+
+def test_delete_floors_at_zero(cfg):
+    f, o = CountingBloomFilter(cfg), CPUBloomFilter(cfg)
+    f.insert_batch([b"once"])
+    o.insert_batch([b"once"])
+    for _ in range(3):  # over-delete
+        f.delete_batch([b"once"])
+        o.delete_batch([b"once"])
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    assert np.asarray(f.words).sum() == 0
+    assert not f.include(b"once")
+
+
+def test_counting_roundtrip_bytes(cfg):
+    rng = np.random.default_rng(2)
+    keys = _rand_keys(200, rng)
+    f = CountingBloomFilter(cfg)
+    f.insert_batch(keys)
+    g = CountingBloomFilter.from_bytes(cfg, f.to_bytes())
+    assert g.include_batch(keys).all()
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+@given(
+    ops=st.lists(
+        st.tuples(st.booleans(), st.lists(st.binary(min_size=1, max_size=8), min_size=1, max_size=30)),
+        min_size=1,
+        max_size=6,
+    )
+)
+@settings(max_examples=20, deadline=None)
+def test_hypothesis_op_sequences(ops):
+    cfg = FilterConfig(m=1 << 14, k=3, key_len=8, counting=True)
+    f, o = CountingBloomFilter(cfg), CPUBloomFilter(cfg)
+    for is_delete, keys in ops:
+        if is_delete:
+            f.delete_batch(keys)
+            o.delete_batch(keys)
+        else:
+            f.insert_batch(keys)
+            o.insert_batch(keys)
+        np.testing.assert_array_equal(np.asarray(f.words), o.words)

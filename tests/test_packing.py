@@ -1,0 +1,69 @@
+"""Key packing + Redis-bitmap byte-order tests (SURVEY.md §5 checkpoint
+compatibility: a :ruby-driver filter must be able to read a :jax-built one)."""
+
+import numpy as np
+import pytest
+
+from tpubloom.utils.packing import (
+    pack_keys,
+    pack_keys_dense,
+    redis_bitmap_to_words,
+    words_to_redis_bitmap,
+)
+
+
+def test_pack_basics():
+    ks, ls = pack_keys([b"abc", b"", "héllo"], 16)
+    assert ks.shape == (3, 16) and ls.tolist() == [3, 0, 6]
+    assert bytes(ks[0, :3]) == b"abc"
+    assert ks[0, 3:].sum() == 0  # zero padding (hash-kernel contract)
+
+
+def test_pack_long_key_policies():
+    with pytest.raises(ValueError):
+        pack_keys([b"x" * 20], 16)
+    ks, ls = pack_keys([b"x" * 20], 16, key_policy="digest")
+    assert ls[0] == 16  # BLAKE2b-16 digest replaces the long key
+    ks2, _ = pack_keys([b"x" * 20], 16, key_policy="digest")
+    np.testing.assert_array_equal(ks, ks2)  # deterministic
+
+
+def test_pack_dense_zeroes_padding():
+    raw = np.full((2, 8), 0xFF, np.uint8)
+    ks, ls = pack_keys_dense(raw, [3, 8])
+    assert ks[0, 3:].sum() == 0 and ks[1].sum() == 8 * 0xFF
+
+
+def test_redis_bitmap_semantics():
+    """Golden check of the SETBIT byte/bit mapping: Redis stores bit n in
+    byte n>>3, bit 7-(n&7) (MSB-first)."""
+    m = 64
+    words = np.zeros(2, np.uint32)
+    for pos in (0, 1, 7, 8, 31, 32, 63):
+        words[pos >> 5] |= np.uint32(1) << np.uint32(pos & 31)
+    data = words_to_redis_bitmap(words, m)
+    assert len(data) == 8
+    for pos in range(m):
+        expected = pos in (0, 1, 7, 8, 31, 32, 63)
+        redis_bit = (data[pos >> 3] >> (7 - (pos & 7))) & 1
+        assert redis_bit == int(expected), f"bit {pos}"
+
+
+def test_redis_bitmap_roundtrip():
+    rng = np.random.default_rng(3)
+    m = 1000  # not a multiple of 32: exercises truncation/zero-fill
+    n_words = (m + 31) // 32
+    words = rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    # zero bits beyond m, as a real filter would have
+    tail_bits = n_words * 32 - m
+    words[-1] &= np.uint32((1 << (32 - tail_bits)) - 1)
+    data = words_to_redis_bitmap(words, m)
+    assert len(data) == (m + 7) // 8
+    back = redis_bitmap_to_words(data, m)
+    np.testing.assert_array_equal(back, words)
+
+
+def test_redis_bitmap_short_data():
+    # Restoring from a shorter-than-m bitmap zero-fills the tail.
+    words = redis_bitmap_to_words(b"\x80", 64)
+    assert words[0] == 1 and words[1] == 0

@@ -1,0 +1,48 @@
+"""Parameter math tests — reference-identical (m, k) sizing (SURVEY.md §2.1)."""
+
+import math
+
+import pytest
+
+from tpubloom.params import optimal_m_k, round_up_pow2, theoretical_fpr
+
+
+def test_textbook_formula():
+    # n=1e6, p=0.01 -> m ≈ 9.585e6 bits, k ≈ 7 — the classic worked example.
+    m, k = optimal_m_k(1_000_000, 0.01)
+    assert m == math.ceil(-1_000_000 * math.log(0.01) / math.log(2) ** 2)
+    assert 9_585_000 < m < 9_586_000
+    assert k == 7
+
+
+def test_north_star_config_consistency():
+    # BASELINE north star: m=2^32, k=7 at <=1% FPR. Capacity at that point:
+    # n = -m ln(2)^2 / ln(p) => inserting that many keys keeps FPR <= 1%.
+    m = 1 << 32
+    n = int(-m * math.log(2) ** 2 / math.log(0.01))
+    assert theoretical_fpr(m, 7, n) <= 0.0105
+
+
+def test_k_at_least_one():
+    m, k = optimal_m_k(10, 0.5)
+    assert k >= 1
+
+
+def test_fpr_monotone_in_n():
+    m, k = 1 << 20, 7
+    fprs = [theoretical_fpr(m, k, n) for n in (0, 1000, 10_000, 100_000)]
+    assert fprs == sorted(fprs)
+    assert fprs[0] == 0.0
+
+
+def test_round_up_pow2():
+    assert round_up_pow2(1) == 1
+    assert round_up_pow2(3) == 4
+    assert round_up_pow2(1024) == 1024
+    assert round_up_pow2(1025) == 2048
+
+
+@pytest.mark.parametrize("bad", [(0, 0.01), (-5, 0.01), (100, 0.0), (100, 1.0)])
+def test_validation(bad):
+    with pytest.raises(ValueError):
+        optimal_m_k(*bad)

@@ -1,0 +1,34 @@
+"""tpubloom — a TPU-native bloom-filter framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``kontera-technologies/redis-bloomfilter`` (see SURVEY.md; the reference mount
+was empty at survey time, so parity targets come from BASELINE.json):
+
+* the per-key hot path (k× MurmurHash3/FNV-1a, then set/test of bits in an
+  m-bit array) runs as batched jit-compiled kernels on TPU,
+* the bit array lives in HBM as a packed ``uint32`` array,
+* inserts are fused scatter-OR, queries fused gather-AND reductions,
+* ``shard_map`` + all-reduce-OR gives multi-chip filter arrays,
+* a counting-filter variant supports delete via 4-bit packed counters,
+* the device bit array checkpoints asynchronously in Redis-string-bitmap
+  format, and
+* a gRPC server exposes the batch API so the original Ruby
+  ``Redis::Bloomfilter`` front-end can select a ``:jax`` driver alongside
+  ``:ruby`` and ``:lua``.
+"""
+
+from tpubloom.version import __version__
+from tpubloom.params import optimal_m_k, theoretical_fpr
+from tpubloom.config import FilterConfig
+from tpubloom.filter import BloomFilter, CountingBloomFilter
+from tpubloom.cpu_ref import CPUBloomFilter
+
+__all__ = [
+    "__version__",
+    "optimal_m_k",
+    "theoretical_fpr",
+    "FilterConfig",
+    "BloomFilter",
+    "CountingBloomFilter",
+    "CPUBloomFilter",
+]

@@ -1,0 +1,146 @@
+"""FilterConfig — the one config object for the whole framework.
+
+Parity: the reference's config surface is the constructor options hash
+``:size, :error_rate, :key_name, :driver, :redis`` (+ ``:hash_engine``)
+(SURVEY.md §5 "Config/flag system" [PK]; BASELINE.json pins the driver
+boundary). We mirror it as a single frozen dataclass — no global flags —
+and derive (m, k) from (capacity, error_rate) with the reference-identical
+math in :mod:`tpubloom.params` so configs are portable between the Ruby
+front-end and this framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpubloom.params import optimal_m_k, round_up_pow2
+
+#: Default seed for the hash family (any fixed u32; part of the filter's
+#: identity — two filters interoperate only if (m, k, seed, hash spec) match).
+DEFAULT_SEED = 0x9747B28C
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterConfig:
+    """Identity + layout of one bloom filter.
+
+    Attributes:
+      m: number of bits in the filter. Powers of two use the 64-bit position
+        path (supports m up to 2^36); non-powers-of-two must be < 2^31 and
+        use the 32-bit path. See ``tpubloom.ops.hashing`` for the exact spec.
+      k: number of hash positions per key.
+      seed: u32 seed for the hash family.
+      key_len: maximum key length in bytes; keys are zero-padded to this
+        length on device. Must be a multiple of 4.
+      key_policy: what to do with keys longer than ``key_len``:
+        ``"error"`` (default) or ``"digest"`` (replace by a 16-byte BLAKE2b
+        digest on the host before packing).
+      counting: counting-filter variant (4-bit counters, supports delete).
+      shards: number of device shards for the sharded filter array
+        (1 = single device). m must be divisible by shards*32.
+      key_name: checkpoint namespace (mirrors the reference's Redis key name).
+      checkpoint_every: insert count between automatic async checkpoints
+        (0 = never).
+    """
+
+    m: int
+    k: int
+    seed: int = DEFAULT_SEED
+    key_len: int = 16
+    key_policy: str = "error"
+    counting: bool = False
+    shards: int = 1
+    key_name: str = "tpubloom"
+    checkpoint_every: int = 0
+
+    def __post_init__(self) -> None:
+        if self.m <= 0:
+            raise ValueError(f"m must be positive, got {self.m}")
+        if not self.m_is_pow2 and self.m >= (1 << 31):
+            raise ValueError(
+                f"non-power-of-two m must be < 2^31 (32-bit position path), got {self.m}"
+            )
+        if self.m_is_pow2 and self.m > (1 << 36):
+            # word indices are int32: pos >> 5 must stay < 2^31 (see
+            # hashing.split_word_bit), so 2^36 bits is the single-array cap.
+            raise ValueError(f"m must be <= 2^36, got {self.m}")
+        if not (1 <= self.k <= 64):
+            raise ValueError(f"k must be in [1, 64], got {self.k}")
+        if self.key_len <= 0 or self.key_len % 4 != 0:
+            raise ValueError(f"key_len must be a positive multiple of 4, got {self.key_len}")
+        if self.key_policy not in ("error", "digest"):
+            raise ValueError(f"key_policy must be 'error' or 'digest', got {self.key_policy}")
+        if not (0 <= self.seed < (1 << 32)):
+            raise ValueError(f"seed must be a u32, got {self.seed}")
+        if self.shards < 1:
+            raise ValueError(f"shards must be >= 1, got {self.shards}")
+        if self.m % (self.shards * 32) != 0:
+            raise ValueError(
+                f"m ({self.m}) must be divisible by shards*32 ({self.shards * 32})"
+            )
+        if self.counting and self.m % 8 != 0:
+            raise ValueError(f"counting filters need m divisible by 8, got {self.m}")
+
+    # -- derived layout ----------------------------------------------------
+
+    @property
+    def m_is_pow2(self) -> bool:
+        return (self.m & (self.m - 1)) == 0
+
+    @property
+    def log2_m(self) -> int:
+        if not self.m_is_pow2:
+            raise ValueError("log2_m only defined for power-of-two m")
+        return self.m.bit_length() - 1
+
+    @property
+    def n_words(self) -> int:
+        """uint32 words in the packed bit array (plain filter)."""
+        return (self.m + 31) // 32
+
+    @property
+    def n_counter_words(self) -> int:
+        """uint32 words in the packed 4-bit counter array (counting filter)."""
+        return (self.m + 7) // 8
+
+    @property
+    def n_words_per_shard(self) -> int:
+        return self.n_words // self.shards
+
+    @property
+    def m_per_shard(self) -> int:
+        return self.m // self.shards
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_capacity(
+        cls,
+        capacity: int,
+        error_rate: float,
+        *,
+        pow2_m: bool = True,
+        **kwargs,
+    ) -> "FilterConfig":
+        """Reference-style sizing: give capacity + error rate, get a filter.
+
+        ``pow2_m=True`` (default) rounds m up to a power of two — strictly
+        more bits, so the configured error rate stays an upper bound — which
+        enables the fast device path (mask instead of mod) and arbitrary m.
+        """
+        m, k = optimal_m_k(capacity, error_rate)
+        if pow2_m:
+            m = round_up_pow2(m)
+        else:
+            m = ((m + 31) // 32) * 32  # keep the packed array whole-word
+        return cls(m=m, k=k, **kwargs)
+
+    def replace(self, **kwargs) -> "FilterConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FilterConfig":
+        return cls(**d)

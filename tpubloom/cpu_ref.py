@@ -1,0 +1,237 @@
+"""CPU reference oracle — the correctness ground truth for every TPU kernel.
+
+A vectorized NumPy bloom filter (plain + counting) implementing the exact
+position spec of :mod:`tpubloom.ops.hashing`; the BASELINE metric is
+"FPR drift vs CPU ref", so every device kernel result (bit positions,
+membership booleans, FPR) is cross-checked against this module in tests
+(SURVEY.md §4.2 item 1).
+
+Parity: this plays the role of the reference's ``:ruby`` driver — the
+client-side, non-accelerated implementation that defines semantics
+(SURVEY.md §2.1; BASELINE config 1 "pure-Ruby driver (CPU ref)"). The hash
+hot path optionally dispatches to the C++ native library
+(``tpubloom/native``) when built, mirroring how the reference leans on a
+native component (Redis) for the heavy lifting.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from tpubloom import native
+from tpubloom.config import FilterConfig
+from tpubloom.ops.hashing import SEED_XOR_GB, SEED_XOR_HB
+from tpubloom.utils.packing import pack_keys, redis_bitmap_to_words, words_to_redis_bitmap
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_FNV_OFFSET = np.uint32(0x811C9DC5)
+_FNV_PRIME = np.uint32(0x01000193)
+
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def murmur3_32_np(keys: np.ndarray, lengths: np.ndarray, seed: int) -> np.ndarray:
+    """Vectorized MurmurHash3_x86_32 — mirrors tpubloom.ops.hashing.murmur3_32."""
+    keys = np.asarray(keys, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int32)
+    L = keys.shape[-1]
+    kb = keys.astype(np.uint32)
+    blocks = (
+        kb[..., 0::4]
+        | (kb[..., 1::4] << np.uint32(8))
+        | (kb[..., 2::4] << np.uint32(16))
+        | (kb[..., 3::4] << np.uint32(24))
+    )
+    h = np.full(lengths.shape, np.uint32(seed), dtype=np.uint32)
+    for i in range(L // 4):
+        kk = blocks[..., i] * _C1
+        kk = _rotl32(kk, 15)
+        kk = kk * _C2
+        rem = lengths - 4 * i
+        h_full = _rotl32(h ^ kk, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+        h_tail = h ^ kk
+        h = np.where(rem >= 4, h_full, np.where(rem > 0, h_tail, h))
+    h = h ^ lengths.astype(np.uint32)
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def fnv1a_32_np(keys: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a 32 — mirrors tpubloom.ops.hashing.fnv1a_32."""
+    keys = np.asarray(keys, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int32)
+    L = keys.shape[-1]
+    h = np.full(lengths.shape, _FNV_OFFSET, dtype=np.uint32)
+    kb = keys.astype(np.uint32)
+    for j in range(L):
+        h_next = (h ^ kb[..., j]) * _FNV_PRIME
+        h = np.where(j < lengths, h_next, h)
+    return h
+
+
+def positions_np(
+    keys: np.ndarray, lengths: np.ndarray, *, m: int, k: int, seed: int
+) -> np.ndarray:
+    """The k positions per key as ``uint64[B, k]`` (exact spec arithmetic)."""
+    h_a = murmur3_32_np(keys, lengths, seed).astype(np.uint64)
+    if (m & (m - 1)) == 0:
+        h_b = murmur3_32_np(keys, lengths, seed ^ SEED_XOR_HB).astype(np.uint64)
+        g_a = fnv1a_32_np(keys, lengths).astype(np.uint64)
+        g_b = murmur3_32_np(keys, lengths, seed ^ SEED_XOR_GB).astype(np.uint64)
+        H1 = (h_b << np.uint64(32)) | h_a
+        H2 = ((g_b << np.uint64(32)) | g_a) | np.uint64(1)
+        i = np.arange(k, dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            pos = H1[..., None] + i * H2[..., None]  # u64 wrap == mod 2^64
+        return pos & np.uint64(m - 1)
+    if m >= (1 << 31):
+        raise ValueError("non-power-of-two m must be < 2^31")
+    g_a = fnv1a_32_np(keys, lengths) | np.uint32(1)
+    i = np.arange(k, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        pos32 = h_a.astype(np.uint32)[..., None] + i * g_a[..., None]
+    return (pos32 % np.uint32(m)).astype(np.uint64)
+
+
+class CPUBloomFilter:
+    """NumPy bloom filter (plain or counting) with the framework's semantics.
+
+    API parity with the reference front-end: ``insert`` / ``include`` /
+    ``clear`` plus the batch forms the BASELINE north star adds
+    (``insert_batch`` / ``include_batch``).
+    """
+
+    def __init__(self, config: FilterConfig, *, use_native: bool | None = None):
+        """``use_native=None`` (default) auto-enables the C++ hot path for
+        plain filters when libbloomhash builds; False forces pure NumPy
+        (the parity tests pin the two paths bit-for-bit)."""
+        self.config = config
+        self.n_inserted = 0
+        if use_native is None:
+            use_native = not config.counting and native.available()
+        if use_native and config.counting:
+            raise ValueError("native path covers plain filters only")
+        self.use_native = use_native
+        if config.counting:
+            self.words = np.zeros(config.n_counter_words, dtype=np.uint32)
+        else:
+            self.words = np.zeros(config.n_words, dtype=np.uint32)
+
+    # -- packing -----------------------------------------------------------
+
+    def _pack(self, keys: Sequence[bytes | str]) -> tuple[np.ndarray, np.ndarray]:
+        return pack_keys(keys, self.config.key_len, key_policy=self.config.key_policy)
+
+    def _positions(self, keys_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        return positions_np(
+            keys_u8, lengths, m=self.config.m, k=self.config.k, seed=self.config.seed
+        )
+
+    # -- plain-filter ops ---------------------------------------------------
+
+    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
+        keys_u8, lengths = self._pack(keys)
+        if self.use_native:
+            native.hash_insert(
+                self.words, keys_u8, lengths,
+                m=self.config.m, k=self.config.k, seed=self.config.seed,
+            )
+        else:
+            pos = self._positions(keys_u8, lengths).ravel()
+            if self.config.counting:
+                self._counter_add(pos, +1)
+            else:
+                word = (pos >> np.uint64(5)).astype(np.int64)
+                bit = (pos & np.uint64(31)).astype(np.uint32)
+                np.bitwise_or.at(self.words, word, np.uint32(1) << bit)
+        self.n_inserted += len(keys)
+
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        keys_u8, lengths = self._pack(keys)
+        if self.use_native:
+            return native.hash_query(
+                self.words, keys_u8, lengths,
+                m=self.config.m, k=self.config.k, seed=self.config.seed,
+            ).astype(bool)
+        pos = self._positions(keys_u8, lengths)
+        if self.config.counting:
+            vals = self._counter_get(pos)
+            return np.all(vals > 0, axis=-1)
+        word = (pos >> np.uint64(5)).astype(np.int64)
+        bit = (pos & np.uint64(31)).astype(np.uint32)
+        hits = (self.words[word] >> bit) & np.uint32(1)
+        return np.all(hits == 1, axis=-1)
+
+    def insert(self, key: bytes | str) -> None:
+        self.insert_batch([key])
+
+    def include(self, key: bytes | str) -> bool:
+        return bool(self.include_batch([key])[0])
+
+    def clear(self) -> None:
+        self.words[:] = 0
+        self.n_inserted = 0
+
+    # -- counting-filter ops ------------------------------------------------
+
+    def delete_batch(self, keys: Sequence[bytes | str]) -> None:
+        if not self.config.counting:
+            raise ValueError("delete requires a counting filter")
+        keys_u8, lengths = self._pack(keys)
+        pos = self._positions(keys_u8, lengths).ravel()
+        self._counter_add(pos, -1)
+        self.n_inserted = max(0, self.n_inserted - len(keys))
+
+    def delete(self, key: bytes | str) -> None:
+        self.delete_batch([key])
+
+    def _counter_add(self, pos: np.ndarray, delta: int) -> None:
+        """Sequential saturating nibble add/sub — the semantic ground truth
+        the device scatter-add kernel must reproduce (increments saturate at
+        15; decrements floor at 0)."""
+        word = (pos >> np.uint64(3)).astype(np.int64)
+        nib = (pos & np.uint64(7)).astype(np.uint32)
+        for w, n in zip(word, nib):
+            shift = np.uint32(4) * n
+            val = (self.words[w] >> shift) & np.uint32(15)
+            new = min(15, int(val) + delta) if delta > 0 else max(0, int(val) + delta)
+            self.words[w] = (self.words[w] & ~(np.uint32(15) << shift)) | (
+                np.uint32(new) << shift
+            )
+
+    def _counter_get(self, pos: np.ndarray) -> np.ndarray:
+        word = (pos >> np.uint64(3)).astype(np.int64)
+        nib = (pos & np.uint64(7)).astype(np.uint32)
+        return (self.words[word] >> (np.uint32(4) * nib)) & np.uint32(15)
+
+    # -- introspection / persistence ----------------------------------------
+
+    def fill_ratio(self) -> float:
+        if self.config.counting:
+            raise ValueError("fill_ratio is for plain filters")
+        set_bits = int(np.unpackbits(self.words.view(np.uint8)).sum())
+        return set_bits / self.config.m
+
+    def estimated_fpr(self) -> float:
+        return self.fill_ratio() ** self.config.k
+
+    def to_redis_bitmap(self) -> bytes:
+        if self.config.counting:
+            raise ValueError("Redis bitmap export is for plain filters")
+        return words_to_redis_bitmap(self.words, self.config.m)
+
+    @classmethod
+    def from_redis_bitmap(cls, config: FilterConfig, data: bytes) -> "CPUBloomFilter":
+        f = cls(config)
+        f.words = redis_bitmap_to_words(data, config.m)
+        return f

@@ -1,0 +1,266 @@
+"""BloomFilter / CountingBloomFilter — the framework's front-end classes.
+
+Parity: mirrors the reference's public API — ``#insert`` / ``#include?`` /
+``#clear`` on ``Redis::Bloomfilter`` (SURVEY.md §1 L1; BASELINE.json: "keeps
+#insert / #include?") — plus the batch forms the north star adds
+(``insert_batch`` / ``include_batch``), the counting variant (config 4), and
+checkpoint import/export in the reference's Redis-string-bitmap format.
+
+TPU-first mechanics:
+
+* the bit array is a device-resident packed ``uint32`` array; insert/query
+  are jit-compiled once per padded batch shape;
+* the insert jit **donates** the bit-array buffer, so updates are in-place in
+  HBM — no 512 MiB copy per batch at m=2^32;
+* host batches are padded to the next power of two (min 64) to bound the
+  jit cache; padded entries carry ``length = -1`` and are dropped in-kernel;
+* ``insert_arrays`` / ``include_arrays`` accept pre-packed device arrays for
+  zero-host-overhead streaming (bench path, gRPC server path).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import bitops, counting, hashing
+from tpubloom.utils.packing import (
+    pack_keys,
+    redis_bitmap_to_words,
+    words_to_redis_bitmap,
+)
+
+
+def _pad_to_bucket(n: int, minimum: int = 64) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+# -- pure kernels (shared with sharded/pipeline/graft paths) -----------------
+
+
+def make_insert_fn(config: FilterConfig):
+    """Pure ``(bits, keys_u8[B,L], lengths[B]) -> bits`` insert step.
+
+    ``lengths < 0`` marks padding. This is the function the single-chip
+    benchmark jits with buffer donation and the sharded filter wraps in
+    ``shard_map``.
+    """
+    m, k, seed = config.m, config.k, config.seed
+
+    def insert(bits, keys_u8, lengths):
+        valid = lengths >= 0
+        ph, pl = hashing.positions(
+            keys_u8, jnp.maximum(lengths, 0), m=m, k=k, seed=seed
+        )
+        word, bit = hashing.split_word_bit(ph, pl)
+        valid_k = jnp.broadcast_to(valid[..., None], word.shape)
+        return bitops.scatter_or(bits, word.ravel(), bit.ravel(), valid_k.ravel())
+
+    return insert
+
+
+def make_query_fn(config: FilterConfig):
+    """Pure ``(bits, keys_u8, lengths) -> bool[B]`` membership step."""
+    m, k, seed = config.m, config.k, config.seed
+
+    def query(bits, keys_u8, lengths):
+        ph, pl = hashing.positions(
+            keys_u8, jnp.maximum(lengths, 0), m=m, k=k, seed=seed
+        )
+        word, bit = hashing.split_word_bit(ph, pl)
+        return bitops.query_membership(bits, word, bit)
+
+    return query
+
+
+def make_counter_fn(config: FilterConfig, *, increment: bool):
+    m, k, seed = config.m, config.k, config.seed
+
+    def update(words, keys_u8, lengths):
+        valid = lengths >= 0
+        ph, pl = hashing.positions(
+            keys_u8, jnp.maximum(lengths, 0), m=m, k=k, seed=seed
+        )
+        del ph  # counting m < 2^31 => positions fit the low word
+        pos = pl.astype(jnp.int32)
+        valid_k = jnp.broadcast_to(valid[..., None], pos.shape)
+        return counting.counter_update(
+            words, pos.ravel(), valid_k.ravel(), increment=increment
+        )
+
+    return update
+
+
+def make_counting_query_fn(config: FilterConfig):
+    m, k, seed = config.m, config.k, config.seed
+
+    def query(words, keys_u8, lengths):
+        _, pl = hashing.positions(
+            keys_u8, jnp.maximum(lengths, 0), m=m, k=k, seed=seed
+        )
+        return counting.counting_membership(words, pl.astype(jnp.int32))
+
+    return query
+
+
+# -- front-end classes -------------------------------------------------------
+
+
+class _FilterBase:
+    """Shared packing / padding / jit plumbing."""
+
+    def __init__(self, config: FilterConfig, n_storage_words: int):
+        self.config = config
+        self.n_inserted = 0
+        self.n_queried = 0
+        self.words = jnp.zeros((n_storage_words,), jnp.uint32)
+
+    def _pack_padded(self, keys: Sequence[bytes | str]):
+        keys_u8, lengths = pack_keys(
+            keys, self.config.key_len, key_policy=self.config.key_policy
+        )
+        B = len(keys)
+        Bp = _pad_to_bucket(B)
+        if Bp != B:
+            keys_u8 = np.pad(keys_u8, ((0, Bp - B), (0, 0)))
+            lengths = np.pad(lengths, (0, Bp - B), constant_values=-1)
+        return keys_u8, lengths, B
+
+    def block_until_ready(self) -> None:
+        self.words.block_until_ready()
+
+    def clear(self) -> None:
+        """Reference ``#clear`` — zero the array (SURVEY.md §3.4: DEL becomes
+        ``jnp.zeros_like``)."""
+        self.words = jnp.zeros_like(self.words)
+        self.n_inserted = 0
+
+
+class BloomFilter(_FilterBase):
+    """Plain bloom filter on a packed ``uint32`` device array."""
+
+    def __init__(self, config: FilterConfig):
+        if config.counting:
+            raise ValueError("use CountingBloomFilter for counting configs")
+        super().__init__(config, config.n_words)
+        self._insert = jax.jit(make_insert_fn(config), donate_argnums=0)
+        self._query = jax.jit(make_query_fn(config))
+
+    # batch API (the north-star surface)
+
+    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words = self._insert(self.words, keys_u8, lengths)
+        self.n_inserted += B
+
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        out = np.asarray(self._query(self.words, keys_u8, lengths))
+        self.n_queried += B
+        return out[:B]
+
+    # pre-packed device-array API (bench / server / streaming path)
+
+    def insert_arrays(self, keys_u8, lengths) -> None:
+        self.words = self._insert(self.words, keys_u8, lengths)
+        self.n_inserted += int(keys_u8.shape[0])
+
+    def include_arrays(self, keys_u8, lengths):
+        self.n_queried += int(keys_u8.shape[0])
+        return self._query(self.words, keys_u8, lengths)
+
+    # scalar API (reference parity)
+
+    def insert(self, key: bytes | str) -> None:
+        self.insert_batch([key])
+
+    def include(self, key: bytes | str) -> bool:
+        return bool(self.include_batch([key])[0])
+
+    __contains__ = include
+
+    # observability (SURVEY.md §5 metrics: fill ratio & predicted FPR)
+
+    def fill_ratio(self) -> float:
+        return float(bitops.popcount_fill(self.words, self.config.m))
+
+    def estimated_fpr(self) -> float:
+        return self.fill_ratio() ** self.config.k
+
+    def stats(self) -> dict:
+        return {
+            "m": self.config.m,
+            "k": self.config.k,
+            "n_inserted": self.n_inserted,
+            "n_queried": self.n_queried,
+            "fill_ratio": self.fill_ratio(),
+            "estimated_fpr": self.estimated_fpr(),
+        }
+
+    # persistence (Redis-string-bitmap format, reference-compatible)
+
+    def to_redis_bitmap(self) -> bytes:
+        return words_to_redis_bitmap(np.asarray(self.words), self.config.m)
+
+    @classmethod
+    def from_redis_bitmap(cls, config: FilterConfig, data: bytes) -> "BloomFilter":
+        f = cls(config)
+        f.words = jnp.asarray(redis_bitmap_to_words(data, config.m))
+        return f
+
+
+class CountingBloomFilter(_FilterBase):
+    """Counting bloom filter: 4-bit saturating counters, supports delete."""
+
+    def __init__(self, config: FilterConfig):
+        if not config.counting:
+            config = config.replace(counting=True)
+        if config.m >= (1 << 31):
+            raise ValueError("counting filters support m < 2^31 (config 4: m=2^30)")
+        super().__init__(config, config.n_counter_words)
+        self._insert = jax.jit(make_counter_fn(config, increment=True), donate_argnums=0)
+        self._delete = jax.jit(make_counter_fn(config, increment=False), donate_argnums=0)
+        self._query = jax.jit(make_counting_query_fn(config))
+
+    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words = self._insert(self.words, keys_u8, lengths)
+        self.n_inserted += B
+
+    def delete_batch(self, keys: Sequence[bytes | str]) -> None:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words = self._delete(self.words, keys_u8, lengths)
+        self.n_inserted = max(0, self.n_inserted - B)
+
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        out = np.asarray(self._query(self.words, keys_u8, lengths))
+        self.n_queried += B
+        return out[:B]
+
+    def insert(self, key: bytes | str) -> None:
+        self.insert_batch([key])
+
+    def delete(self, key: bytes | str) -> None:
+        self.delete_batch([key])
+
+    def include(self, key: bytes | str) -> bool:
+        return bool(self.include_batch([key])[0])
+
+    __contains__ = include
+
+    def to_bytes(self) -> bytes:
+        return np.asarray(self.words).astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(cls, config: FilterConfig, data: bytes) -> "CountingBloomFilter":
+        f = cls(config)
+        f.words = jnp.asarray(np.frombuffer(data, dtype="<u4").astype(np.uint32))
+        return f

@@ -1,0 +1,142 @@
+"""ctypes loader for the native C++ hash library (builds on first import).
+
+No pybind11 in the environment, so the boundary is plain C ABI + ctypes
+(SURVEY.md §2.1 native-component obligation). Everything degrades gracefully:
+``HAS_NATIVE`` is False and callers fall back to the NumPy oracle if g++ or
+the build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "bloomhash.cpp")
+_LIB_PATH = os.path.join(_HERE, "libbloomhash.so")
+
+_lock = threading.Lock()
+_lib = None
+HAS_NATIVE = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        _SRC, "-o", _LIB_PATH,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load():
+    global _lib, HAS_NATIVE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.bh_murmur3_batch.argtypes = [u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32, u32p]
+        lib.bh_fnv1a_batch.argtypes = [u8p, i32p, ctypes.c_int64, ctypes.c_int32, u32p]
+        lib.bh_positions.argtypes = [u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32, u64p]
+        lib.bh_insert.argtypes = [u32p, u64p, ctypes.c_int64]
+        lib.bh_query.argtypes = [u32p, u64p, ctypes.c_int64, ctypes.c_int32, u8p]
+        lib.bh_hash_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32]
+        lib.bh_hash_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32, u8p]
+        _lib = lib
+        HAS_NATIVE = True
+        return lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def murmur3_batch(keys: np.ndarray, lens: np.ndarray, seed: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    out = np.empty(B, dtype=np.uint32)
+    lib.bh_murmur3_batch(
+        _ptr(keys, ctypes.c_uint8), _ptr(lens, ctypes.c_int32), B, L,
+        ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint32),
+    )
+    return out
+
+
+def fnv1a_batch(keys: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    out = np.empty(B, dtype=np.uint32)
+    lib.bh_fnv1a_batch(
+        _ptr(keys, ctypes.c_uint8), _ptr(lens, ctypes.c_int32), B, L,
+        _ptr(out, ctypes.c_uint32),
+    )
+    return out
+
+
+def positions_batch(keys: np.ndarray, lens: np.ndarray, *, m: int, k: int, seed: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    out = np.empty((B, k), dtype=np.uint64)
+    lib.bh_positions(
+        _ptr(keys, ctypes.c_uint8), _ptr(lens, ctypes.c_int32), B, L,
+        ctypes.c_uint64(m), k, ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint64),
+    )
+    return out
+
+
+def hash_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int, k: int, seed: int) -> None:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    lib.bh_hash_insert(
+        _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
+        _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(m), k,
+        ctypes.c_uint32(seed),
+    )
+
+
+def hash_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int, k: int, seed: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    out = np.empty(B, dtype=np.uint8)
+    lib.bh_hash_query(
+        _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
+        _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(m), k,
+        ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint8),
+    )
+    return out

@@ -1,0 +1,210 @@
+// bloomhash — native (C++) host-side hot path for tpubloom.
+//
+// Parity role: the reference's only native component is the Redis C server
+// (storage + server-side execution; SURVEY.md §2.1 "Native-component
+// obligation"). In this framework the accelerated tier is XLA:TPU; this
+// library is the *host* native tier: bit-exact MurmurHash3_x86_32 / FNV-1a,
+// double-hash position derivation, and packed bit-array insert/query loops
+// used by the CPU oracle (BASELINE config 1) and by the gRPC server for
+// fast key packing. Must match tpubloom/ops/hashing.py bit for bit — tests
+// enforce parity against the jnp and NumPy implementations.
+//
+// Built as a shared library via g++ (no Rust in the environment); loaded
+// through ctypes (no pybind11 in the environment).
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// MurmurHash3_x86_32 (public-domain algorithm by Austin Appleby).
+static uint32_t murmur3_32(const uint8_t* data, int len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+  uint32_t h1 = seed;
+  const int nblocks = len / 4;
+  for (int i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + 4 * i, 4);  // little-endian load
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5u + 0xe6546b64u;
+  }
+  const uint8_t* tail = data + 4 * nblocks;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+  h1 ^= (uint32_t)len;
+  return fmix32(h1);
+}
+
+static uint32_t fnv1a_32(const uint8_t* data, int len) {
+  uint32_t h = 0x811c9dc5u;
+  for (int i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= 0x01000193u;
+  }
+  return h;
+}
+
+// Seed-derivation constants — must match tpubloom/ops/hashing.py.
+static const uint32_t SEED_XOR_HB = 0x9E3779B9u;
+static const uint32_t SEED_XOR_GB = 0x85EBCA6Bu;
+
+extern "C" {
+
+void bh_murmur3_batch(const uint8_t* keys, const int32_t* lens, int64_t B,
+                      int32_t L, uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < B; i++) {
+    out[i] = murmur3_32(keys + i * L, lens[i], seed);
+  }
+}
+
+void bh_fnv1a_batch(const uint8_t* keys, const int32_t* lens, int64_t B,
+                    int32_t L, uint32_t* out) {
+  for (int64_t i = 0; i < B; i++) {
+    out[i] = fnv1a_32(keys + i * L, lens[i]);
+  }
+}
+
+// k positions per key, exact spec of tpubloom/ops/hashing.py:
+//   pow2 m:      pos_i = (H1 + i*H2 mod 2^64) mod m
+//   non-pow2 m:  pos_i = ((h_a + i*(g_a|1)) mod 2^32) mod m
+void bh_positions(const uint8_t* keys, const int32_t* lens, int64_t B,
+                  int32_t L, uint64_t m, int32_t k, uint32_t seed,
+                  uint64_t* out) {
+  const bool pow2 = (m & (m - 1)) == 0;
+  for (int64_t i = 0; i < B; i++) {
+    const uint8_t* key = keys + i * L;
+    const int len = lens[i];
+    const uint32_t h_a = murmur3_32(key, len, seed);
+    if (pow2) {
+      const uint32_t h_b = murmur3_32(key, len, seed ^ SEED_XOR_HB);
+      const uint32_t g_a = fnv1a_32(key, len);
+      const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
+      const uint64_t H1 = ((uint64_t)h_b << 32) | h_a;
+      const uint64_t H2 = (((uint64_t)g_b << 32) | g_a) | 1ull;
+      uint64_t pos = H1;
+      for (int j = 0; j < k; j++) {
+        out[i * k + j] = pos & (m - 1);
+        pos += H2;  // u64 wrap == mod 2^64
+      }
+    } else {
+      const uint32_t g_a = fnv1a_32(key, len) | 1u;
+      uint32_t pos = h_a;
+      for (int j = 0; j < k; j++) {
+        out[i * k + j] = pos % (uint32_t)m;
+        pos += g_a;  // u32 wrap == mod 2^32
+      }
+    }
+  }
+}
+
+// Packed-u32 bit-array ops (LSB-first within word, same layout as device).
+void bh_insert(uint32_t* words, const uint64_t* pos, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    words[pos[i] >> 5] |= 1u << (pos[i] & 31);
+  }
+}
+
+void bh_query(const uint32_t* words, const uint64_t* pos, int64_t B,
+              int32_t k, uint8_t* out) {
+  for (int64_t i = 0; i < B; i++) {
+    uint8_t hit = 1;
+    for (int32_t j = 0; j < k; j++) {
+      const uint64_t p = pos[i * k + j];
+      hit &= (uint8_t)((words[p >> 5] >> (p & 31)) & 1u);
+      if (!hit) break;  // short-circuit, like the reference's :ruby driver
+    }
+    out[i] = hit;
+  }
+}
+
+// Fused hash+insert / hash+query — the native CPU baseline hot loop
+// (BASELINE config 1 measures this tier).
+void bh_hash_insert(uint32_t* words, const uint8_t* keys, const int32_t* lens,
+                    int64_t B, int32_t L, uint64_t m, int32_t k,
+                    uint32_t seed) {
+  const bool pow2 = (m & (m - 1)) == 0;
+  for (int64_t i = 0; i < B; i++) {
+    const uint8_t* key = keys + i * L;
+    const int len = lens[i];
+    const uint32_t h_a = murmur3_32(key, len, seed);
+    if (pow2) {
+      const uint32_t h_b = murmur3_32(key, len, seed ^ SEED_XOR_HB);
+      const uint32_t g_a = fnv1a_32(key, len);
+      const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
+      const uint64_t H2 = (((uint64_t)g_b << 32) | g_a) | 1ull;
+      uint64_t pos = ((uint64_t)h_b << 32) | h_a;
+      for (int j = 0; j < k; j++) {
+        const uint64_t p = pos & (m - 1);
+        words[p >> 5] |= 1u << (p & 31);
+        pos += H2;
+      }
+    } else {
+      const uint32_t g_a = fnv1a_32(key, len) | 1u;
+      uint32_t pos = h_a;
+      for (int j = 0; j < k; j++) {
+        const uint32_t p = pos % (uint32_t)m;
+        words[p >> 5] |= 1u << (p & 31);
+        pos += g_a;
+      }
+    }
+  }
+}
+
+void bh_hash_query(const uint32_t* words, const uint8_t* keys,
+                   const int32_t* lens, int64_t B, int32_t L, uint64_t m,
+                   int32_t k, uint32_t seed, uint8_t* out) {
+  const bool pow2 = (m & (m - 1)) == 0;
+  for (int64_t i = 0; i < B; i++) {
+    const uint8_t* key = keys + i * L;
+    const int len = lens[i];
+    const uint32_t h_a = murmur3_32(key, len, seed);
+    uint8_t hit = 1;
+    if (pow2) {
+      const uint32_t h_b = murmur3_32(key, len, seed ^ SEED_XOR_HB);
+      const uint32_t g_a = fnv1a_32(key, len);
+      const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
+      const uint64_t H2 = (((uint64_t)g_b << 32) | g_a) | 1ull;
+      uint64_t pos = ((uint64_t)h_b << 32) | h_a;
+      for (int j = 0; j < k && hit; j++) {
+        const uint64_t p = pos & (m - 1);
+        hit &= (uint8_t)((words[p >> 5] >> (p & 31)) & 1u);
+        pos += H2;
+      }
+    } else {
+      const uint32_t g_a = fnv1a_32(key, len) | 1u;
+      uint32_t pos = h_a;
+      for (int j = 0; j < k && hit; j++) {
+        const uint32_t p = pos % (uint32_t)m;
+        hit &= (uint8_t)((words[p >> 5] >> (p & 31)) & 1u);
+        pos += g_a;
+      }
+    }
+    out[i] = hit;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,1 @@
+"""Device-side ops: hashing, bit kernels, counting kernels, Pallas kernels."""

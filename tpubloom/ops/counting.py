@@ -1,0 +1,94 @@
+"""Counting-filter kernels: saturating scatter-add on packed 4-bit counters.
+
+Parity: BASELINE config 4 — "Counting Bloom filter variant (4-bit counters,
+m=2^30) — insert/delete/query mix, exercises scatter-add". The counting
+variant restores delete support, which a plain bloom filter lacks
+(SURVEY.md §2.3).
+
+Layout: counter ``pos`` lives in word ``pos >> 3``, nibble ``pos & 7`` of a
+packed ``uint32[m / 8]`` array. Semantics (ground truth in
+``cpu_ref._counter_add``): increments saturate at 15, decrements floor at 0,
+and duplicate positions within one batch apply their full multiplicity
+(clamped once against the pre-batch value — matching a sequential
+apply-then-clamp only when no mid-batch crossing occurs; both oracles use
+the same one-clamp rule so they agree bit-for-bit).
+
+Why not plain scatter-add: nibble saturation must not carry into the
+neighboring counter, and duplicate indices must be combined *before*
+clamping. The kernel therefore does a two-level segmented reduction over one
+sort: counts per counter (runs of equal pos), clamped against the gathered
+current nibble, then summed per word — contributions live in disjoint nibble
+lanes, so the word-level sum cannot carry — and scatter-set uniquely.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpubloom.ops.bitops import segmented_scan_last
+
+
+def _u32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.uint32)
+
+
+def counter_update(
+    words: jnp.ndarray,
+    pos: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    increment: bool,
+) -> jnp.ndarray:
+    """Apply a saturating +1/-1 per valid position to the packed counters.
+
+    Args:
+      words: ``uint32[n_counter_words]`` packed 4-bit counters.
+      pos: ``int32[N]`` counter positions (flattened batch × k); requires
+        m < 2^31 (config.m for counting filters is at most 2^30 per BASELINE).
+      valid: ``bool[N]`` batch-padding mask.
+      increment: True for insert (+1, saturate 15), False for delete
+        (-1, floor 0).
+    """
+    n_words = words.shape[0]
+    sentinel = jnp.int32(n_words * 8)
+    p = jnp.where(valid, pos, sentinel).astype(jnp.int32)
+    (p,) = lax.sort((p,), num_keys=1)
+
+    # Level 1: multiplicity of each distinct counter position.
+    ones = jnp.ones_like(p, jnp.uint32)
+    counts, pos_last = segmented_scan_last(p, ones, jnp.add)
+
+    word = jnp.minimum(p >> 3, n_words - 1)
+    nib = (p & 7).astype(jnp.uint32)
+    shift = _u32(4) * nib
+    val = (words[word] >> shift) & _u32(15)
+
+    if increment:
+        delta = jnp.minimum(counts, _u32(15) - val)
+    else:
+        delta = jnp.minimum(counts, val)
+    # Only the last element of each counter-run contributes, in its own
+    # nibble lane — lanes are disjoint within a word, so summing cannot carry.
+    contrib = jnp.where(pos_last, delta << shift, _u32(0))
+
+    # Level 2: sum contributions per word (p sorted => word sorted).
+    wkey = (p >> 3).astype(jnp.int32)
+    contrib_sum, word_last = segmented_scan_last(wkey, contrib, jnp.add)
+
+    target = jnp.where(word_last & (wkey < n_words), wkey, n_words)
+    current = words[word]
+    merged = current + contrib_sum if increment else current - contrib_sum
+    return words.at[target].set(merged, mode="drop", unique_indices=True)
+
+
+def counter_get(words: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """Gather counter values: ``uint32[...]`` in [0, 15]."""
+    word = pos >> 3
+    shift = _u32(4) * (pos & 7).astype(jnp.uint32)
+    return (words[word] >> shift) & _u32(15)
+
+
+def counting_membership(words: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """``bool[B]``: all k counters of each key are nonzero (pos is [B, k])."""
+    return jnp.all(counter_get(words, pos) > 0, axis=-1)

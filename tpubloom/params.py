@@ -1,0 +1,48 @@
+"""Bloom-filter parameter math: (capacity, error_rate) -> (m, k).
+
+Parity: the reference front-end computes optimal ``m`` (bits) and ``k`` (hash
+count) from desired capacity + error rate with the textbook formulas
+``m = -n·ln(p)/ln(2)²`` and ``k = (m/n)·ln(2)`` (SURVEY.md §2.1,
+"Parameter math", expected in lib/redis-bloomfilter.rb [PK]; pinned by
+BASELINE.json north_star which fixes m=2^32, k=7 at ≤1% FPR).
+
+Kept dependency-free (pure ``math``) so the Ruby client, the CPU oracle and
+the device kernels can all share one source of truth for sizing.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def optimal_m_k(capacity: int, error_rate: float) -> tuple[int, int]:
+    """Return ``(m, k)`` — bit-array size and hash count — for a filter that
+    holds ``capacity`` keys at false-positive probability ``error_rate``.
+
+    ``m = ceil(-n·ln(p) / ln(2)²)``, ``k = max(1, round((m/n)·ln(2)))``.
+    """
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    if not (0.0 < error_rate < 1.0):
+        raise ValueError(f"error_rate must be in (0, 1), got {error_rate}")
+    n = float(capacity)
+    p = float(error_rate)
+    m = math.ceil(-n * math.log(p) / (math.log(2.0) ** 2))
+    k = max(1, round((m / n) * math.log(2.0)))
+    return m, k
+
+
+def theoretical_fpr(m: int, k: int, n: int) -> float:
+    """Expected false-positive rate after inserting ``n`` keys:
+    ``(1 - e^(-k·n/m))^k``."""
+    if n == 0:
+        return 0.0
+    return (1.0 - math.exp(-k * n / m)) ** k
+
+
+def round_up_pow2(x: int) -> int:
+    """Smallest power of two >= x (device-friendly m; pow2 m enables the
+    64-bit position path and turns mod into a bit mask)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()

@@ -1,0 +1,1 @@
+"""Host-side utilities: key packing, Redis-bitmap byte-order conversion."""

@@ -1,0 +1,110 @@
+"""Host-side key packing and Redis-bitmap byte-order conversion.
+
+Key packing turns variable-length byte-string keys into the fixed-shape
+``uint8[B, L]`` + ``int32[B]`` arrays the device hash kernels consume
+(TPU/XLA want static shapes — SURVEY.md §7 "Hard parts").
+
+Redis-bitmap conversion keeps the reference's storage format: the reference
+persists the filter as a Redis string bitmap written via SETBIT, where bit
+``n`` lives in byte ``n >> 3`` at bit ``7 - (n & 7)`` (MSB-first within the
+byte). Our packed ``uint32`` layout puts bit ``n`` in word ``n >> 5`` at
+``1 << (n & 31)`` (LSB-first). Little-endian word serialization makes the
+*byte* index agree (``(n >> 5)*4 + ((n >> 3) & 3) == n >> 3``), so the
+formats differ only by within-byte bit order — a 256-entry bit-reversal
+table converts in one vectorized pass. This is what lets a ``:ruby``-driver
+filter read a ``:jax``-built checkpoint and vice versa (SURVEY.md §5
+"Checkpoint/resume").
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+_BIT_REVERSE = np.array(
+    [int(f"{i:08b}"[::-1], 2) for i in range(256)], dtype=np.uint8
+)
+
+
+def pack_keys(
+    keys: Sequence[bytes | str],
+    key_len: int,
+    *,
+    key_policy: str = "error",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack keys into zero-padded ``uint8[B, key_len]`` + ``int32[B]`` lengths.
+
+    str keys are UTF-8 encoded. Keys longer than ``key_len`` either raise
+    (``key_policy='error'``) or are replaced by their 16-byte BLAKE2b digest
+    (``key_policy='digest'`` — requires ``key_len >= 16``); the digest is
+    deterministic, so filter semantics are preserved up to digest collisions.
+    """
+    if key_policy == "digest" and key_len < 16:
+        raise ValueError("key_policy='digest' requires key_len >= 16")
+    B = len(keys)
+    out = np.zeros((B, key_len), dtype=np.uint8)
+    lens = np.zeros((B,), dtype=np.int32)
+    for i, key in enumerate(keys):
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        elif not isinstance(key, (bytes, bytearray, memoryview)):
+            raise TypeError(f"key {i} must be bytes or str, got {type(key)}")
+        kb = bytes(key)
+        if len(kb) > key_len:
+            if key_policy == "error":
+                raise ValueError(
+                    f"key {i} is {len(kb)} bytes > key_len={key_len}; "
+                    "use key_policy='digest' or raise key_len"
+                )
+            kb = hashlib.blake2b(kb, digest_size=16).digest()
+        out[i, : len(kb)] = np.frombuffer(kb, dtype=np.uint8)
+        lens[i] = len(kb)
+    return out, lens
+
+
+def pack_keys_dense(keys: np.ndarray, lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Validate an already-packed (keys, lengths) pair and zero the padding.
+
+    Accepts ``uint8[B, L]`` + integer lengths; returns arrays with every byte
+    at position >= length forced to zero (the hash-kernel contract).
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int32)
+    if keys.ndim != 2 or lengths.shape != (keys.shape[0],):
+        raise ValueError(f"bad shapes: keys {keys.shape}, lengths {lengths.shape}")
+    mask = np.arange(keys.shape[1], dtype=np.int32)[None, :] < lengths[:, None]
+    return np.where(mask, keys, 0).astype(np.uint8), lengths
+
+
+def words_to_redis_bitmap(words: np.ndarray, m: int) -> bytes:
+    """Serialize a packed ``uint32`` bit array to Redis SETBIT byte order.
+
+    The output is exactly the Redis string value the reference's ``:ruby``
+    driver would have produced by SETBIT-ing the same positions, truncated
+    to ``ceil(m / 8)`` bytes.
+    """
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    le = words.view(np.uint8) if words.dtype.byteorder in ("<", "=") else None
+    if le is None or not _is_little_endian():
+        le = words.astype("<u4").view(np.uint8)
+    rev = _BIT_REVERSE[le]
+    nbytes = (m + 7) // 8
+    return rev[:nbytes].tobytes()
+
+
+def redis_bitmap_to_words(data: bytes, m: int) -> np.ndarray:
+    """Parse a Redis string bitmap back into our packed ``uint32`` array."""
+    n_words = (m + 31) // 32
+    buf = np.zeros(n_words * 4, dtype=np.uint8)
+    nbytes = min(len(data), (m + 7) // 8)
+    buf[:nbytes] = np.frombuffer(data, dtype=np.uint8, count=nbytes)
+    rev = _BIT_REVERSE[buf]
+    return rev.view("<u4").astype(np.uint32, copy=False)
+
+
+def _is_little_endian() -> bool:
+    import sys
+
+    return sys.byteorder == "little"

@@ -1,0 +1,7 @@
+"""Version constant.
+
+Parity: the reference gem exposes ``Redis::Bloomfilter::VERSION``
+(SURVEY.md §2.1, expected at lib/redis-bloomfilter/version.rb [PK]).
+"""
+
+__version__ = "0.1.0"

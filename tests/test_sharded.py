@@ -1,0 +1,150 @@
+"""Sharded filter array tests on the fake 8-device CPU mesh
+(BASELINE config 5 scaled down; SURVEY.md §4.2 item 3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpubloom import CPUBloomFilter, FilterConfig
+from tpubloom.cpu_ref import murmur3_32_np
+from tpubloom.ops.hashing import SEED_XOR_ROUTE
+from tpubloom.parallel.sharded import ShardedBloomFilter, make_mesh
+from tpubloom.utils.packing import pack_keys
+
+
+def _rand_keys(n, rng, nbytes=16):
+    return [rng.bytes(nbytes) for _ in range(n)]
+
+
+class ShardedCPURef:
+    """Oracle: n independent CPU filters + the routing hash."""
+
+    def __init__(self, config):
+        self.config = config
+        local = FilterConfig(
+            m=config.m_per_shard, k=config.k, seed=config.seed,
+            key_len=config.key_len,
+        )
+        self.filters = [
+            CPUBloomFilter(local, use_native=False) for _ in range(config.shards)
+        ]
+
+    def _route(self, keys):
+        ks, ls = pack_keys(keys, self.config.key_len)
+        return murmur3_32_np(ks, ls, self.config.seed ^ SEED_XOR_ROUTE) % np.uint32(
+            self.config.shards
+        )
+
+    def insert_batch(self, keys):
+        routes = self._route(keys)
+        for key, r in zip(keys, routes):
+            self.filters[r].insert(key)
+
+    def include_batch(self, keys):
+        routes = self._route(keys)
+        return np.array(
+            [self.filters[r].include(key) for key, r in zip(keys, routes)]
+        )
+
+
+@pytest.fixture(scope="module")
+def cfg8():
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    return FilterConfig(m=1 << 20, k=5, key_len=16, shards=8)
+
+
+def test_roundtrip(cfg8):
+    rng = np.random.default_rng(0)
+    keys = _rand_keys(2000, rng)
+    f = ShardedBloomFilter(cfg8)
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    absent = _rand_keys(2000, rng)
+    assert f.include_batch(absent).mean() < 0.01
+
+
+def test_parity_vs_sharded_oracle(cfg8):
+    """The mesh implementation and the compose-n-CPU-filters oracle agree
+    bit-for-bit: same routing, same per-shard positions, same answers."""
+    rng = np.random.default_rng(1)
+    keys = _rand_keys(500, rng) + [b"", b"a", b"sharded-key"]
+    f, o = ShardedBloomFilter(cfg8), ShardedCPURef(cfg8)
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    dev_words = np.asarray(f.words)  # [shards, words_local]
+    for s in range(cfg8.shards):
+        np.testing.assert_array_equal(
+            dev_words[s], o.filters[s].words, err_msg=f"shard {s} bits differ"
+        )
+    probe = keys + _rand_keys(500, rng)
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
+
+
+def test_all_shards_used(cfg8):
+    rng = np.random.default_rng(2)
+    f = ShardedBloomFilter(cfg8)
+    f.insert_batch(_rand_keys(4000, rng))
+    per_shard_bits = np.asarray(f.words).astype(np.uint64)
+    pops = [
+        int(np.unpackbits(per_shard_bits[s].astype(np.uint32).view(np.uint8)).sum())
+        for s in range(cfg8.shards)
+    ]
+    assert all(p > 0 for p in pops), f"some shard never written: {pops}"
+    # routing is roughly balanced
+    assert max(pops) < 2 * min(pops)
+
+
+def test_logical_shards_exceed_devices():
+    # 16 shards on 8 devices: 2 shard-rows per device (config-5 shape).
+    cfg = FilterConfig(m=1 << 20, k=4, key_len=16, shards=16)
+    rng = np.random.default_rng(3)
+    keys = _rand_keys(1000, rng)
+    f = ShardedBloomFilter(cfg)
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    o = ShardedCPURef(cfg)
+    o.insert_batch(keys)
+    dev_words = np.asarray(f.words)
+    for s in range(cfg.shards):
+        np.testing.assert_array_equal(dev_words[s], o.filters[s].words)
+
+
+def test_sharded_redis_bitmap_roundtrip(cfg8):
+    rng = np.random.default_rng(4)
+    keys = _rand_keys(1000, rng)
+    f = ShardedBloomFilter(cfg8)
+    f.insert_batch(keys)
+    blob = f.to_redis_bitmap()
+    assert len(blob) == cfg8.m // 8
+    g = ShardedBloomFilter.from_redis_bitmap(cfg8, blob)
+    assert g.include_batch(keys).all()
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+def test_clear(cfg8):
+    f = ShardedBloomFilter(cfg8)
+    f.insert_batch([b"x", b"y"])
+    f.clear()
+    assert not f.include_batch([b"x", b"y"]).any()
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        ShardedBloomFilter(FilterConfig(m=1 << 20, k=4, shards=1))
+    with pytest.raises(ValueError):
+        # 6 shards on 8 devices: not divisible either way
+        ShardedBloomFilter(FilterConfig(m=3 * (1 << 18), k=4, shards=6))
+
+
+def test_graft_entry_single():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    words, hits = jax.jit(fn)(*args)
+    assert bool(np.asarray(hits).all()), "keys just inserted must be present"
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)

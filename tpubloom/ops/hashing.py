@@ -56,6 +56,11 @@ _FNV_PRIME = 0x01000193
 # Seed derivation constants (part of the position spec above).
 SEED_XOR_HB = 0x9E3779B9
 SEED_XOR_GB = 0x85EBCA6B
+# Shard-routing hash seed (sharded filter array, BASELINE config 5):
+# shard(key) = murmur3_32(key, seed XOR SEED_XOR_ROUTE) mod n_shards.
+# Independent of the position hashes so routing doesn't correlate with
+# within-shard positions.
+SEED_XOR_ROUTE = 0x517CC1B7
 
 
 def _u32(x) -> jnp.ndarray:
@@ -200,6 +205,14 @@ def _positions_mod(keys, lengths, *, m: int, k: int, seed: int):
         out.append(pos % _u32(m))
     lo = jnp.stack(out, axis=-1)
     return jnp.zeros_like(lo), lo
+
+
+def route_shards(
+    keys: jnp.ndarray, lengths: jnp.ndarray, *, n_shards: int, seed: int
+) -> jnp.ndarray:
+    """Owning shard of each key: ``uint32[...]`` in [0, n_shards)."""
+    h = murmur3_32(keys, lengths, seed ^ SEED_XOR_ROUTE)
+    return h % _u32(n_shards)
 
 
 def split_word_bit(

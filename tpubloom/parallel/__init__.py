@@ -1,0 +1,1 @@
+"""Multi-chip parallelism: sharded filter arrays, distributed init, streaming."""

@@ -1,0 +1,131 @@
+# Driver::Jax — the :jax execution driver for Redis::Bloomfilter.
+#
+# Parity: plugs into the reference's driver-selection boundary
+# (SURVEY.md §1 L2: ":ruby / :lua -> new :jax"; BASELINE.json north star).
+# Same duck-typed contract as the :ruby and :lua drivers — #insert,
+# #include?, #clear — plus the batch surface the north star adds:
+# #insert_batch and #include_batch?. Instead of issuing SETBIT/GETBIT (or
+# EVALSHA) against Redis, every call ships key batches over gRPC to the
+# colocated tpubloom JAX process, which holds the bit array in TPU HBM and
+# checkpoints it back to Redis in the reference's own bitmap format (so a
+# :ruby-driver reader still works against the checkpoint).
+#
+# Wire format: gRPC unary calls on /tpubloom.BloomService/<Method> with
+# msgpack-encoded maps (see tpubloom/server/protocol.py — the environment
+# that generated the server has no protoc codegen, and msgpack-ruby is
+# ubiquitous). Requires gems: grpc, msgpack.
+#
+# NOTE: written against the documented server protocol but UNTESTED in the
+# build environment (no Ruby toolchain in the image); exercised end-to-end
+# via the Python client, which speaks the identical wire format.
+
+require "grpc"
+require "msgpack"
+
+class Redis
+  class Bloomfilter
+    module Driver
+      class Jax
+        SERVICE = "tpubloom.BloomService".freeze
+        METHODS = %w[
+          Health CreateFilter DropFilter ListFilters
+          InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint
+        ].freeze
+
+        IDENTITY = proc { |bytes| bytes }
+
+        # opts mirrors the reference constructor options plus:
+        #   :address       - "host:port" of the tpubloom server (default
+        #                    127.0.0.1:50051)
+        #   :size          - expected capacity (n)
+        #   :error_rate    - desired false-positive probability
+        #   :key_name      - filter name (also the Redis checkpoint key)
+        #   :counting      - use the counting variant (enables #delete)
+        def initialize(opts = {})
+          @opts = opts
+          @name = opts[:key_name] || "tpubloom"
+          address = opts[:address] || "127.0.0.1:50051"
+          @stub = GRPC::ClientStub.new(address, :this_channel_is_insecure)
+          create_filter
+        end
+
+        def insert(key)
+          insert_batch([key])
+        end
+
+        def insert_batch(keys)
+          rpc("InsertBatch", "name" => @name, "keys" => keys.map(&:to_s))
+          true
+        end
+
+        def include?(key)
+          include_batch?([key]).first
+        end
+
+        # Returns an array of booleans, one per key.
+        def include_batch?(keys)
+          resp = rpc("QueryBatch", "name" => @name, "keys" => keys.map(&:to_s))
+          unpack_bits(resp["hits"], resp["n"])
+        end
+
+        def delete(key)
+          rpc("DeleteBatch", "name" => @name, "keys" => [key.to_s])
+          true
+        end
+
+        def clear
+          rpc("Clear", "name" => @name)
+          true
+        end
+
+        def stats
+          rpc("Stats", "name" => @name)["stats"]
+        end
+
+        def checkpoint
+          rpc("Checkpoint", "name" => @name, "wait" => true)["seq"]
+        end
+
+        private
+
+        def create_filter
+          req = { "name" => @name, "exist_ok" => true }
+          if @opts[:config]
+            req["config"] = @opts[:config]
+          else
+            req["capacity"] = @opts[:size] || 1_000_000
+            req["error_rate"] = @opts[:error_rate] || 0.01
+            options = {}
+            options["counting"] = true if @opts[:counting]
+            req["options"] = options
+          end
+          rpc("CreateFilter", req)
+        end
+
+        def rpc(method, payload)
+          raw = @stub.request_response(
+            "/#{SERVICE}/#{method}",
+            payload.to_msgpack,
+            IDENTITY,
+            IDENTITY
+          )
+          resp = MessagePack.unpack(raw)
+          unless resp["ok"]
+            err = resp["error"] || {}
+            raise "tpubloom #{err['code'] || 'UNKNOWN'}: #{err['message']}"
+          end
+          resp
+        end
+
+        # Server packs hits MSB-first (numpy packbits); n trailing pad bits.
+        def unpack_bits(bytes, n)
+          out = []
+          bytes.each_byte do |b|
+            7.downto(0) { |i| out << (((b >> i) & 1) == 1) }
+          end
+          out.first(n)
+        end
+      end
+    end
+  end
+end

@@ -1,0 +1,122 @@
+"""In-process fake Redis speaking enough RESP2 for the checkpoint sink.
+
+Plays the role the reference's test suite gives a live local redis-server
+(SURVEY.md §4.1) — no redis-server exists in this environment, so a ~100
+line threaded socket server stands in. It implements PING/SET/GET/DEL/
+EXISTS/SETBIT/GETBIT over a dict; SETBIT/GETBIT let tests check that our
+exported bitmaps answer exactly like Redis would for the reference's
+``:ruby`` driver.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class FakeRedis:
+    def __init__(self):
+        self.data: dict[bytes, bytearray] = {}
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        try:
+            while True:
+                line = read_line()
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    continue
+                nargs = int(line[1:])
+                args = []
+                for _ in range(nargs):
+                    hdr = read_line()
+                    assert hdr.startswith(b"$")
+                    args.append(read_exact(int(hdr[1:])))
+                    read_exact(2)
+                conn.sendall(self._dispatch(args))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        if cmd == b"PING":
+            return b"+PONG\r\n"
+        if cmd == b"SET":
+            self.data[args[1]] = bytearray(args[2])
+            return b"+OK\r\n"
+        if cmd == b"GET":
+            val = self.data.get(args[1])
+            if val is None:
+                return b"$-1\r\n"
+            return b"$%d\r\n%s\r\n" % (len(val), bytes(val))
+        if cmd == b"DEL":
+            n = sum(1 for k in args[1:] if self.data.pop(k, None) is not None)
+            return b":%d\r\n" % n
+        if cmd == b"EXISTS":
+            n = sum(1 for k in args[1:] if k in self.data)
+            return b":%d\r\n" % n
+        if cmd == b"SETBIT":
+            key, off, val = args[1], int(args[2]), int(args[3])
+            buf = self.data.setdefault(key, bytearray())
+            byte = off >> 3
+            if len(buf) <= byte:
+                buf.extend(b"\x00" * (byte + 1 - len(buf)))
+            old = (buf[byte] >> (7 - (off & 7))) & 1
+            if val:
+                buf[byte] |= 1 << (7 - (off & 7))
+            else:
+                buf[byte] &= ~(1 << (7 - (off & 7))) & 0xFF
+            return b":%d\r\n" % old
+        if cmd == b"GETBIT":
+            key, off = args[1], int(args[2])
+            buf = self.data.get(key, bytearray())
+            byte = off >> 3
+            bit = 0 if byte >= len(buf) else (buf[byte] >> (7 - (off & 7))) & 1
+            return b":%d\r\n" % bit
+        return b"-ERR unknown command %s\r\n" % cmd
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass

@@ -1,0 +1,233 @@
+"""Checkpoint/restore tests (SURVEY.md §4.2 item 5; BASELINE config 3's
+periodic-checkpoint requirement; §5 failure-detection: bounded tail loss)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.fake_redis import FakeRedis
+from tpubloom import BloomFilter, CountingBloomFilter, CPUBloomFilter, FilterConfig
+from tpubloom import checkpoint as ckpt
+from tpubloom.parallel.sharded import ShardedBloomFilter
+from tpubloom.server.resp import RespClient, RespError
+
+
+def _rand_keys(n, rng, nbytes=16):
+    return [rng.bytes(nbytes) for _ in range(n)]
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return FilterConfig(m=1 << 20, k=5, key_len=16, key_name="ckpt-test")
+
+
+def test_file_roundtrip(cfg, tmp_path):
+    rng = np.random.default_rng(0)
+    keys = _rand_keys(2000, rng)
+    f = BloomFilter(cfg)
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    seq = ckpt.save(f, sink)
+    g = ckpt.restore(cfg, sink)
+    assert g is not None and g._restored_seq == seq
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    assert g.include_batch(keys).all()
+
+
+def test_restore_picks_newest(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    f.insert(b"first")
+    ckpt.save(f, sink, seq=100000000000)
+    f.insert(b"second")
+    ckpt.save(f, sink, seq=100000000001)
+    g = ckpt.restore(cfg, sink)
+    assert g._restored_seq == 100000000001
+    assert g.include(b"first") and g.include(b"second")
+
+
+def test_restore_empty_sink(cfg, tmp_path):
+    assert ckpt.restore(cfg, ckpt.FileSink(str(tmp_path))) is None
+
+
+def test_config_mismatch_rejected(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    f.insert(b"x")
+    ckpt.save(f, sink)
+    with pytest.raises(ValueError, match="mismatch on k"):
+        ckpt.restore(cfg.replace(k=7), sink)
+
+
+def test_shards_mismatch_rejected(tmp_path):
+    cfg = FilterConfig(m=1 << 20, k=4, shards=8, key_name="sh")
+    f = ShardedBloomFilter(cfg)
+    f.insert(b"x")
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    with pytest.raises(ValueError, match="mismatch on shards"):
+        ckpt.restore(cfg.replace(shards=4), sink)
+
+
+def test_redis_sink_rejects_old_seq(cfg):
+    srv = FakeRedis()
+    try:
+        sink = ckpt.RedisSink("127.0.0.1", srv.port)
+        f = BloomFilter(cfg)
+        f.insert(b"x")
+        seq = ckpt.save(f, sink)
+        assert ckpt.restore(cfg, sink, seq=seq) is not None
+        with pytest.raises(ValueError, match="newest checkpoint"):
+            ckpt.restore(cfg, sink, seq=seq - 1)
+        sink.close()
+    finally:
+        srv.close()
+
+
+def test_counting_roundtrip(tmp_path):
+    cfg = FilterConfig(m=1 << 16, k=4, counting=True, key_name="cnt")
+    f = CountingBloomFilter(cfg)
+    f.insert_batch([b"a", b"b", b"a"])
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    g = ckpt.restore(cfg, sink)
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    g.delete(b"a")
+    assert g.include(b"a")  # still one count left
+    g.delete(b"a")
+    assert not g.include(b"a")
+
+
+def test_sharded_roundtrip(tmp_path):
+    cfg = FilterConfig(m=1 << 20, k=4, shards=8, key_name="shard-ckpt")
+    rng = np.random.default_rng(1)
+    keys = _rand_keys(1000, rng)
+    f = ShardedBloomFilter(cfg)
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    g = ckpt.restore(cfg, sink)
+    assert isinstance(g, ShardedBloomFilter)
+    assert g.include_batch(keys).all()
+
+
+def test_file_prune(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    for s in range(100000000000, 100000000005):
+        ckpt.save(f, sink, seq=s)
+    sink.prune(cfg.key_name, keep=2)
+    assert sink.latest_seq(cfg.key_name) == 100000000004
+    assert sink.get(cfg.key_name, 100000000000) is None
+
+
+# -- RESP client + Redis sink -----------------------------------------------
+
+
+def test_resp_client_basics():
+    srv = FakeRedis()
+    try:
+        with RespClient("127.0.0.1", srv.port) as c:
+            assert c.ping()
+            assert c.set("k", b"\x00\x01binary\xff")
+            assert c.get("k") == b"\x00\x01binary\xff"
+            assert c.get("absent") is None
+            assert c.exists("k") == 1
+            assert c.delete("k") == 1
+            assert c.exists("k") == 0
+            with pytest.raises(RespError):
+                c.command("BOGUS")
+    finally:
+        srv.close()
+
+
+def test_redis_sink_roundtrip_and_ruby_driver_compat(cfg):
+    """The Redis sink leaves the raw bitmap under key_name, so a reference
+    :ruby driver doing GETBIT against Redis sees exactly our bits."""
+    srv = FakeRedis()
+    try:
+        rng = np.random.default_rng(2)
+        keys = _rand_keys(500, rng)
+        f = BloomFilter(cfg)
+        f.insert_batch(keys)
+        sink = ckpt.RedisSink("127.0.0.1", srv.port)
+        ckpt.save(f, sink)
+        g = ckpt.restore(cfg, sink)
+        np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+        # GETBIT emulation of the reference's per-position query loop:
+        oracle = CPUBloomFilter(cfg, use_native=False)
+        from tpubloom.cpu_ref import positions_np
+        from tpubloom.utils.packing import pack_keys
+
+        ks, ls = pack_keys(keys[:50], cfg.key_len)
+        pos = positions_np(ks, ls, m=cfg.m, k=cfg.k, seed=cfg.seed)
+        with RespClient("127.0.0.1", srv.port) as c:
+            for row in pos:
+                bits = [c.command("GETBIT", cfg.key_name, int(p)) for p in row]
+                assert all(b == 1 for b in bits), "ruby-driver view must see the key"
+        sink.close()
+    finally:
+        srv.close()
+
+
+def test_setbit_written_filter_readable_by_jax(cfg):
+    """Reverse direction: a filter built by reference-style SETBIT commands
+    restores into the device filter with identical membership."""
+    srv = FakeRedis()
+    try:
+        oracle = CPUBloomFilter(cfg, use_native=False)
+        keys = [b"ruby-key-%d" % i for i in range(200)]
+        oracle.insert_batch(keys)
+        from tpubloom.cpu_ref import positions_np
+        from tpubloom.utils.packing import pack_keys
+
+        ks, ls = pack_keys(keys, cfg.key_len)
+        pos = positions_np(ks, ls, m=cfg.m, k=cfg.k, seed=cfg.seed)
+        with RespClient("127.0.0.1", srv.port) as c:
+            for p in sorted(set(int(x) for x in pos.ravel())):
+                c.command("SETBIT", cfg.key_name, p, 1)
+            bitmap = c.get(cfg.key_name)
+        f = BloomFilter.from_redis_bitmap(cfg, bitmap)
+        assert f.include_batch(keys).all()
+        np.testing.assert_array_equal(np.asarray(f.words)[: len(oracle.words)][
+            : oracle.words.size], oracle.words)
+    finally:
+        srv.close()
+
+
+# -- async checkpointer ------------------------------------------------------
+
+
+def test_async_checkpointer(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    cp = ckpt.AsyncCheckpointer(f, sink, every_n_inserts=1000)
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        f.insert_batch(_rand_keys(500, rng))
+        cp.notify_inserts(500)
+    cp.close(final_checkpoint=True)
+    assert cp.checkpoints_written >= 2
+    assert cp.last_error is None
+    g = ckpt.restore(cfg, sink)
+    assert g is not None
+    # final checkpoint captured everything
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+def test_async_checkpointer_skips_when_busy(cfg, tmp_path):
+    class SlowSink(ckpt.FileSink):
+        def put(self, *a):
+            time.sleep(0.2)
+            super().put(*a)
+
+    sink = SlowSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    cp = ckpt.AsyncCheckpointer(f, sink)
+    assert cp.trigger()
+    assert not cp.trigger(), "second trigger while busy must be refused"
+    cp.flush()
+    cp.close(final_checkpoint=False)
+    assert cp.checkpoints_written == 1

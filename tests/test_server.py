@@ -1,0 +1,127 @@
+"""gRPC server/client integration tests (SURVEY.md §1 L4 boundary; the
+batch API the BASELINE north star adds). Real grpc over localhost."""
+
+import numpy as np
+import pytest
+
+from tpubloom import checkpoint as ckpt
+from tpubloom.server.client import BloomClient
+from tpubloom.server.protocol import BloomServiceError
+from tpubloom.server.service import BloomService, build_server
+
+
+@pytest.fixture()
+def server(tmp_path):
+    service = BloomService(sink_factory=lambda config: ckpt.FileSink(str(tmp_path)))
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    yield client, service, tmp_path
+    client.close()
+    srv.stop(grace=None)
+
+
+def _rand_keys(n, rng):
+    return [rng.bytes(16) for _ in range(n)]
+
+
+def test_health(server):
+    client, _, _ = server
+    h = client.health()
+    assert h["ok"] and h["backend"] == "cpu" and len(h["devices"]) == 8
+
+
+def test_end_to_end_roundtrip(server):
+    client, _, _ = server
+    client.create_filter("urls", capacity=100_000, error_rate=0.01)
+    assert client.list_filters() == ["urls"]
+    rng = np.random.default_rng(0)
+    keys = _rand_keys(5000, rng)
+    assert client.insert_batch("urls", keys) == 5000
+    assert client.include_batch("urls", keys).all()
+    absent = _rand_keys(5000, rng)
+    assert client.include_batch("urls", absent).mean() < 0.01
+    st = client.stats("urls")
+    assert st["n_inserted"] == 5000 and st["fill_ratio"] > 0
+    client.clear("urls")
+    assert not client.include_batch("urls", keys[:100]).any()
+
+
+def test_scalar_and_str_keys(server):
+    client, _, _ = server
+    client.create_filter("mix", capacity=1000, error_rate=0.01)
+    client.insert("mix", "héllo")
+    assert client.include("mix", "héllo")
+    assert not client.include("mix", "absent")
+
+
+def test_counting_filter_via_server(server):
+    client, _, _ = server
+    client.create_filter(
+        "cnt", config={"m": 1 << 16, "k": 4, "counting": True}
+    )
+    client.insert_batch("cnt", [b"a", b"b"])
+    client.delete_batch("cnt", [b"b"])
+    assert client.include("cnt", b"a") and not client.include("cnt", b"b")
+
+
+def test_delete_on_plain_filter_rejected(server):
+    client, _, _ = server
+    client.create_filter("plain", capacity=1000, error_rate=0.01)
+    with pytest.raises(BloomServiceError, match="UNSUPPORTED"):
+        client.delete_batch("plain", [b"x"])
+
+
+def test_errors(server):
+    client, _, _ = server
+    with pytest.raises(BloomServiceError, match="NOT_FOUND"):
+        client.insert_batch("ghost", [b"x"])
+    client.create_filter("dup", capacity=100, error_rate=0.1)
+    with pytest.raises(BloomServiceError, match="ALREADY_EXISTS"):
+        client.create_filter("dup", capacity=100, error_rate=0.1)
+    assert client.create_filter("dup", capacity=100, error_rate=0.1, exist_ok=True)[
+        "existed"
+    ]
+
+
+def test_checkpoint_restart_cycle(server):
+    """Server restart restores the newest checkpoint (SURVEY.md §5 failure
+    row: server restart -> restore newest checkpoint)."""
+    client, service, tmp_path = server
+    client.create_filter("persist", capacity=10_000, error_rate=0.01)
+    rng = np.random.default_rng(1)
+    keys = _rand_keys(1000, rng)
+    client.insert_batch("persist", keys)
+    seq = client.checkpoint("persist", wait=True)["seq"]
+    assert seq > 0
+    # simulate restart: drop from memory (final checkpoint), recreate
+    client.drop_filter("persist")
+    assert client.list_filters() == []
+    resp = client.create_filter("persist", capacity=10_000, error_rate=0.01)
+    assert resp["restored_seq"] is not None
+    assert client.include_batch("persist", keys).all()
+
+
+def test_sharded_filter_via_server(server):
+    client, _, _ = server
+    client.create_filter(
+        "sharded", config={"m": 1 << 20, "k": 4, "shards": 8}
+    )
+    rng = np.random.default_rng(2)
+    keys = _rand_keys(2000, rng)
+    client.insert_batch("sharded", keys)
+    assert client.include_batch("sharded", keys).all()
+    st = client.stats("sharded")
+    assert st["shards"] == 8
+
+
+def test_server_metrics(server):
+    client, _, _ = server
+    client.create_filter("m1", capacity=1000, error_rate=0.01)
+    client.insert_batch("m1", [b"k1", b"k2"])
+    client.include_batch("m1", [b"k1"])
+    snap = client.stats()
+    assert snap["counters"]["keys_inserted"] == 2
+    assert snap["counters"]["keys_queried"] == 1
+    assert snap["latency"]["InsertBatch"]["n"] >= 1

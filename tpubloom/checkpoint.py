@@ -1,0 +1,329 @@
+"""Async checkpoint/resume of the device bit array.
+
+Parity: the reference delegates persistence entirely to Redis (RDB/AOF
+snapshots of the bitmap string; SURVEY.md §5 "Checkpoint/resume").
+Here it is first-class (BASELINE: "Redis persistence degrades to an async
+checkpoint of the device bit-array"):
+
+* **snapshot**: the filter's packed array is first copied HBM->HBM (a fast
+  on-device copy — necessary because inserts jit with buffer donation,
+  which recycles the *original* buffer in place as soon as the next insert
+  runs), then copied device->host asynchronously and handed to a background
+  writer thread. Inserts resume as soon as the on-device copy is enqueued.
+  ``trigger()`` must not race a donating insert — call it from the same
+  thread as inserts, or under the filter's op lock (the server does);
+* **formats**: plain filters serialize to the reference's Redis-string-bitmap
+  format (a ``:ruby``-driver filter can read a ``:jax``-built checkpoint);
+  counting/sharded payloads add nothing new — counting uses raw
+  little-endian words, sharded uses the shard-major global bitmap;
+* **sinks**: a local file directory, or a real Redis via the zero-dependency
+  RESP client (``tpubloom.server.resp``) — ``SET key_name <bitmap>`` exactly
+  like the reference would have left it;
+* **monotonic sequence numbers** tag every snapshot; restore picks the
+  newest. Crash-consistency contract: a lagging checkpoint only loses the
+  most recent inserts, never corrupts (scatter-OR is monotone) — the
+  fault-injection test pins this.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpubloom.config import FilterConfig
+
+MAGIC = b"TPUBLOOM1\n"
+
+_CKPT_RE = re.compile(r"^(?P<name>.+)\.(?P<seq>\d{12,})\.ckpt$")
+
+
+def _serialize(config: FilterConfig, seq: int, words: np.ndarray) -> bytes:
+    """Self-describing checkpoint: magic + json header + payload.
+
+    Plain filters store the payload in Redis-bitmap byte order so the blob
+    under the payload offset is byte-identical to what the reference's
+    SETBIT loop would have produced; counting filters store raw LE words.
+    """
+    from tpubloom.utils.packing import words_to_redis_bitmap
+
+    if config.counting:
+        payload = words.astype("<u4").tobytes()
+        fmt = "counting_le_words"
+    else:
+        payload = words_to_redis_bitmap(words.reshape(-1), config.m)
+        fmt = "redis_bitmap"
+    header = json.dumps(
+        {
+            "config": config.to_dict(),
+            "seq": seq,
+            "format": fmt,
+            "time": time.time(),
+        }
+    ).encode()
+    return MAGIC + len(header).to_bytes(8, "little") + header + payload
+
+
+def _deserialize(data: bytes) -> Tuple[dict, bytes]:
+    if not data.startswith(MAGIC):
+        raise ValueError("not a tpubloom checkpoint (bad magic)")
+    off = len(MAGIC)
+    hlen = int.from_bytes(data[off : off + 8], "little")
+    header = json.loads(data[off + 8 : off + 8 + hlen])
+    return header, data[off + 8 + hlen :]
+
+
+def payload_to_words(config: FilterConfig, header: dict, payload: bytes) -> np.ndarray:
+    from tpubloom.utils.packing import redis_bitmap_to_words
+
+    if header["format"] == "counting_le_words":
+        return np.frombuffer(payload, dtype="<u4").astype(np.uint32)
+    return redis_bitmap_to_words(payload, config.m)
+
+
+class FileSink:
+    """Checkpoints as ``<dir>/<key_name>.<seq>.ckpt`` files (atomic rename)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def put(self, key_name: str, seq: int, blob: bytes) -> None:
+        final = os.path.join(self.directory, f"{key_name}.{seq:012d}.ckpt")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    def latest_seq(self, key_name: str) -> Optional[int]:
+        best = None
+        for fn in os.listdir(self.directory):
+            mm = _CKPT_RE.match(fn)
+            if mm and mm.group("name") == key_name:
+                s = int(mm.group("seq"))
+                best = s if best is None else max(best, s)
+        return best
+
+    def get(self, key_name: str, seq: Optional[int] = None) -> Optional[bytes]:
+        if seq is None:
+            seq = self.latest_seq(key_name)
+            if seq is None:
+                return None
+        path = os.path.join(self.directory, f"{key_name}.{seq:012d}.ckpt")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def prune(self, key_name: str, keep: int = 2) -> None:
+        seqs = sorted(
+            int(m.group("seq"))
+            for fn in os.listdir(self.directory)
+            if (m := _CKPT_RE.match(fn)) and m.group("name") == key_name
+        )
+        for s in seqs[:-keep] if keep else seqs:
+            os.unlink(os.path.join(self.directory, f"{key_name}.{s:012d}.ckpt"))
+
+
+class RedisSink:
+    """Checkpoints into a live Redis, keeping the reference's storage model.
+
+    Two keys are written: ``<key_name>`` holds the RAW Redis bitmap — the
+    exact string the reference's ``:ruby`` driver GETBITs against, readable
+    by stock Redis tooling — and ``<key_name>:tpubloom.ckpt`` holds the
+    framed checkpoint (header + payload) for seq/config-aware restore.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379, **kwargs):
+        from tpubloom.server.resp import RespClient
+
+        self._client = RespClient(host, port, **kwargs)
+        self._lock = threading.Lock()
+
+    def put(self, key_name: str, seq: int, blob: bytes) -> None:
+        header, payload = _deserialize(blob)
+        with self._lock:
+            if header["format"] == "redis_bitmap":
+                self._client.set(key_name, payload)
+            self._client.set(f"{key_name}:tpubloom.ckpt", blob)
+
+    def latest_seq(self, key_name: str) -> Optional[int]:
+        blob = self.get(key_name)
+        if blob is None:
+            return None
+        header, _ = _deserialize(blob)
+        return header["seq"]
+
+    def get(self, key_name: str, seq: Optional[int] = None) -> Optional[bytes]:
+        with self._lock:
+            blob = self._client.get(f"{key_name}:tpubloom.ckpt")
+        if blob is not None and seq is not None:
+            header, _ = _deserialize(blob)
+            if header["seq"] != seq:
+                raise ValueError(
+                    f"RedisSink keeps only the newest checkpoint "
+                    f"(seq {header['seq']}); requested seq {seq} is unavailable"
+                )
+        return blob
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def save(filter_obj, sink, *, seq: Optional[int] = None) -> int:
+    """Synchronous snapshot of any filter (plain/counting/sharded)."""
+    seq = seq if seq is not None else int(time.time() * 1000)
+    words = np.asarray(filter_obj.words)
+    sink.put(filter_obj.config.key_name, seq, _serialize(filter_obj.config, seq, words))
+    return seq
+
+
+def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
+    """Rebuild a filter from the newest (or given) checkpoint in ``sink``.
+
+    Returns a BloomFilter / CountingBloomFilter / ShardedBloomFilter
+    according to ``config``, or None if the sink has no checkpoint.
+    Config identity (m, k, seed, counting) must match the checkpoint —
+    positions are only portable between identical hash configs.
+    """
+    blob = sink.get(config.key_name, seq)
+    if blob is None:
+        return None
+    header, payload = _deserialize(blob)
+    saved = header["config"]
+    # shards is identity-relevant: the sharded payload is shard-major with
+    # per-shard-local positions, so a different shard count reinterprets
+    # the same bytes under a different layout and hash mapping.
+    for field in ("m", "k", "seed", "counting", "shards"):
+        if saved[field] != getattr(config, field):
+            raise ValueError(
+                f"checkpoint/config mismatch on {field}: "
+                f"saved={saved[field]} requested={getattr(config, field)}"
+            )
+    words = payload_to_words(config, header, payload)
+    if config.counting:
+        from tpubloom.filter import CountingBloomFilter
+
+        f = CountingBloomFilter(config)
+        import jax.numpy as jnp
+
+        f.words = jnp.asarray(words)
+    elif config.shards > 1:
+        from tpubloom.parallel.sharded import ShardedBloomFilter
+        import jax
+
+        f = ShardedBloomFilter(config)
+        f.words = jax.device_put(
+            words.reshape(config.shards, config.n_words_per_shard), f.sharding
+        )
+    else:
+        from tpubloom.filter import BloomFilter
+        import jax.numpy as jnp
+
+        f = BloomFilter(config)
+        f.words = jnp.asarray(words)
+    f._restored_seq = header["seq"]
+    return f
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer with bounded lag.
+
+    ``notify_inserts(n)`` after each batch; every ``every_n_inserts`` a
+    snapshot is taken (device->host copy started immediately, serialization
+    + sink write on the worker thread). If a write is still in flight the
+    trigger is deferred — checkpoints never queue up unboundedly, inserts
+    are never blocked (SURVEY.md §5 failure-detection row: config 3 requires
+    periodic checkpointing with bounded tail loss on crash).
+    """
+
+    def __init__(self, filter_obj, sink, *, every_n_inserts: int = 0):
+        self.filter = filter_obj
+        self.sink = sink
+        self.every_n_inserts = every_n_inserts
+        self._since_last = 0
+        # Millisecond-epoch base keeps sequence numbers monotonic across
+        # process restarts (restore picks the max seq in the sink).
+        self._seq = int(time.time() * 1000)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._busy = threading.Event()
+        self._trigger_lock = threading.Lock()
+        self._stop = False
+        self.last_error: Optional[Exception] = None
+        self.checkpoints_written = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            seq, words = item
+            try:
+                # np.asarray blocks until the async D2H copy lands.
+                blob = _serialize(self.filter.config, seq, np.asarray(words))
+                self.sink.put(self.filter.config.key_name, seq, blob)
+                self.checkpoints_written += 1
+                self.last_error = None  # a success clears a transient failure
+            except Exception as e:  # surfaced via last_error + health checks
+                self.last_error = e
+            finally:
+                self._busy.clear()
+
+    def notify_inserts(self, n: int) -> None:
+        self._since_last += n
+        if self.every_n_inserts and self._since_last >= self.every_n_inserts:
+            if self.trigger():
+                self._since_last = 0
+
+    def trigger(self) -> bool:
+        """Start an async checkpoint now; False if one is still in flight.
+
+        Must not run concurrently with a donating insert on the same filter
+        (caller provides that exclusion — see module docstring).
+        """
+        with self._trigger_lock:
+            if self._stop or self._busy.is_set():
+                return False
+            self._busy.set()
+            self._seq = max(self._seq + 1, int(time.time() * 1000))
+            words = self.filter.words
+        if hasattr(words, "copy_to_host_async"):
+            # jax.Array: snapshot to a fresh device buffer (immune to the
+            # next insert donating the original), then start the D2H copy.
+            import jax.numpy as jnp
+
+            words = jnp.array(words, copy=True)
+            words.copy_to_host_async()
+        else:
+            words = np.array(words, copy=True)
+        self._queue.put((self._seq, words))
+        return True
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until the in-flight checkpoint (if any) is written."""
+        deadline = time.time() + timeout
+        while self._busy.is_set() and time.time() < deadline:
+            time.sleep(0.005)
+
+    def close(self, *, final_checkpoint: bool = True) -> None:
+        if final_checkpoint:
+            self.flush()
+            self.trigger()
+            self.flush()
+        self._stop = True
+        self._queue.put(None)
+        self._worker.join(timeout=30)
+
+    @property
+    def seq(self) -> int:
+        return self._seq

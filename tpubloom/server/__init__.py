@@ -1,0 +1,1 @@
+"""Host-side runtime: gRPC service, RESP (Redis protocol) client, metrics."""

@@ -1,0 +1,6 @@
+"""``python -m tpubloom.server [port] [checkpoint_dir]``"""
+
+from tpubloom.server.service import main
+
+if __name__ == "__main__":
+    main()

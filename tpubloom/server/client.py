@@ -1,0 +1,122 @@
+"""Python client for the tpubloom gRPC service.
+
+Parity: the Python-native mirror of the Ruby ``:jax`` driver (SURVEY.md §1
+layer-map row L1: "Python-native API mirrors it") — same batch surface as
+the local :class:`tpubloom.filter.BloomFilter`, but over the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import grpc
+import numpy as np
+
+from tpubloom.server import protocol
+
+
+class BloomClient:
+    """Blocking client; one instance per channel, filters addressed by name."""
+
+    def __init__(self, address: str = "127.0.0.1:50051", *, timeout: float = 60.0):
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._calls = {
+            m: self._channel.unary_unary(
+                protocol.method_path(m),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            for m in protocol.METHODS
+        }
+
+    def _rpc(self, method: str, req: dict) -> dict:
+        raw = self._calls[method](protocol.encode(req), timeout=self.timeout)
+        return protocol.check(protocol.decode(raw))
+
+    # -- service-level -------------------------------------------------------
+
+    def health(self) -> dict:
+        return self._rpc("Health", {})
+
+    def wait_ready(self, timeout: float = 30.0) -> dict:
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        return self.health()
+
+    def create_filter(
+        self,
+        name: str,
+        *,
+        capacity: Optional[int] = None,
+        error_rate: Optional[float] = None,
+        config: Optional[dict] = None,
+        exist_ok: bool = False,
+        restore: bool = True,
+        **options,
+    ) -> dict:
+        req: dict = {"name": name, "exist_ok": exist_ok, "restore": restore}
+        if config is not None:
+            req["config"] = config
+        else:
+            req["capacity"] = capacity
+            req["error_rate"] = error_rate
+            req["options"] = options
+        return self._rpc("CreateFilter", req)
+
+    def drop_filter(self, name: str, *, final_checkpoint: bool = True) -> dict:
+        return self._rpc(
+            "DropFilter", {"name": name, "final_checkpoint": final_checkpoint}
+        )
+
+    def list_filters(self) -> list:
+        return self._rpc("ListFilters", {})["filters"]
+
+    # -- per-filter ops ------------------------------------------------------
+
+    @staticmethod
+    def _keys(keys: Sequence[bytes | str]) -> list:
+        return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+
+    def insert_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
+        return self._rpc("InsertBatch", {"name": name, "keys": self._keys(keys)})["n"]
+
+    def include_batch(self, name: str, keys: Sequence[bytes | str]) -> np.ndarray:
+        resp = self._rpc("QueryBatch", {"name": name, "keys": self._keys(keys)})
+        return np.unpackbits(
+            np.frombuffer(resp["hits"], np.uint8), count=resp["n"]
+        ).astype(bool)
+
+    def delete_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
+        return self._rpc("DeleteBatch", {"name": name, "keys": self._keys(keys)})["n"]
+
+    def insert(self, name: str, key: bytes | str) -> None:
+        self.insert_batch(name, [key])
+
+    def include(self, name: str, key: bytes | str) -> bool:
+        return bool(self.include_batch(name, [key])[0])
+
+    def clear(self, name: str) -> None:
+        self._rpc("Clear", {"name": name})
+
+    def stats(self, name: Optional[str] = None) -> dict:
+        resp = self._rpc("Stats", {"name": name} if name else {})
+        return resp.get("stats", resp.get("server"))
+
+    def checkpoint(self, name: str, *, wait: bool = True) -> dict:
+        return self._rpc("Checkpoint", {"name": name, "wait": wait})
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,88 @@
+"""Server observability: counters + latency histograms.
+
+Parity: the reference gem has no metrics; operators lean on Redis
+INFO/SLOWLOG (SURVEY.md §5 "Metrics/logging/observability"). The build
+equivalent pinned there: keys inserted/queried, batch sizes, kernel/request
+latency, checkpoint lag, fill ratio & predicted FPR (the filter classes
+provide the last two via ``stats()``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class LatencyHistogram:
+    """Fixed log2 buckets from 1us to ~67s — cheap, lock-free enough."""
+
+    BUCKETS = [2**i for i in range(27)]  # microseconds
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BUCKETS) + 1)
+        self.total_us = 0
+        self.n = 0
+
+    def observe(self, seconds: float) -> None:
+        us = seconds * 1e6
+        self.total_us += us
+        self.n += 1
+        for i, b in enumerate(self.BUCKETS):
+            if us < b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def summary(self) -> dict:
+        if not self.n:
+            return {"n": 0}
+        cum = 0
+        out = {"n": self.n, "mean_us": self.total_us / self.n}
+        for q in (0.5, 0.99):
+            target = q * self.n
+            cum = 0
+            for i, c in enumerate(self.counts):
+                cum += c
+                if cum >= target:
+                    out[f"p{int(q * 100)}_us_lt"] = (
+                        self.BUCKETS[i] if i < len(self.BUCKETS) else float("inf")
+                    )
+                    break
+        return out
+
+
+class Metrics:
+    """Process-wide counters + per-RPC latency histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = defaultdict(int)
+        self.latency: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
+        self.started_at = time.time()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def time_rpc(self, method: str):
+        m = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                with m._lock:
+                    m.latency[method].observe(time.perf_counter() - self.t0)
+
+        return _Timer()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "uptime_s": time.time() - self.started_at,
+                "counters": dict(self.counters),
+                "latency": {k: v.summary() for k, v in self.latency.items()},
+            }

@@ -1,0 +1,67 @@
+"""Wire protocol for the tpubloom gRPC service.
+
+Parity: this is the L4 transport of the layer map — the reference's
+redis-rb/RESP hop becomes a gRPC channel from the (Ruby or Python) client
+to the colocated JAX process (SURVEY.md §1; BASELINE: "#insert_batch /
+#include_batch? ... ship key batches over a thin gRPC shim").
+
+Implementation note: the environment has the ``grpc`` runtime but not
+``grpc_tools`` (no protoc codegen for Python), so the service uses gRPC's
+generic method handlers with **msgpack-encoded request/response maps**
+instead of compiled protobufs. msgpack handles raw-byte keys natively, has
+first-class Ruby support (the reference's ecosystem), and keeps the wire
+format hand-decodable. Every message is a msgpack map; bulk key payloads
+are msgpack ``bin`` arrays.
+
+Service: ``/tpubloom.BloomService/<Method>`` for Method in METHODS.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+SERVICE = "tpubloom.BloomService"
+
+METHODS = (
+    "Health",
+    "CreateFilter",
+    "DropFilter",
+    "ListFilters",
+    "InsertBatch",
+    "QueryBatch",
+    "DeleteBatch",
+    "Clear",
+    "Stats",
+    "Checkpoint",
+)
+
+
+def encode(msg: dict) -> bytes:
+    return msgpack.packb(msg, use_bin_type=True)
+
+
+def decode(data: bytes) -> dict:
+    return msgpack.unpackb(data, raw=False)
+
+
+def method_path(method: str) -> str:
+    return f"/{SERVICE}/{method}"
+
+
+def error_response(code: str, message: str) -> dict:
+    return {"ok": False, "error": {"code": code, "message": message}}
+
+
+def check(resp: dict) -> dict:
+    """Client-side: raise on an error response, else return it."""
+    if not resp.get("ok", False):
+        err = resp.get("error", {})
+        raise BloomServiceError(err.get("code", "UNKNOWN"), err.get("message", ""))
+    return resp
+
+
+class BloomServiceError(RuntimeError):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""tpubloom benchmark — BASELINE north-star metric.
+
+Measures batched insert+query throughput at m=2^32, k=7 (BASELINE.json
+north_star: >= 1e9 keys/sec/chip on TPU v5e at <= 1% FPR) and prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Honest-measurement notes (SURVEY.md §6 feasibility):
+
+* Keys are generated ON DEVICE inside the jitted step (jax.random.bits) —
+  the host in this image has one CPU core and PCIe could never feed 1B
+  16-byte keys/sec, so host->device ingestion is excluded by design and
+  reported separately as `e2e_keys_per_sec` for a host-fed batch.
+* One unit of work = one key inserted AND queried (the insert+query pair),
+  matching the metric name "insert+query keys/sec".
+* The TPU attempt runs in a subprocess with a hard timeout: the axon TPU
+  tunnel in this image can hang indefinitely at client init (see
+  .claude/skills/verify/SKILL.md); on timeout/failure the benchmark falls
+  back to CPU and says so in the JSON (`platform` field) rather than
+  printing nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BASELINE_TARGET = 1e9  # keys/sec/chip, BASELINE.json north_star
+
+TPU_TIMEOUT_S = int(os.environ.get("TPUBLOOM_BENCH_TPU_TIMEOUT", "900"))
+CPU_TIMEOUT_S = int(os.environ.get("TPUBLOOM_BENCH_CPU_TIMEOUT", "600"))
+
+
+def _run_bench(platform: str) -> dict:
+    """Child-process body: the actual measurement."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpubloom.config import FilterConfig
+    from tpubloom.filter import make_insert_fn, make_query_fn
+    from tpubloom.ops import hashing
+    from tpubloom.utils.packing import pack_keys
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    # North-star scale on TPU; reduced on the 1-core CPU fallback so the
+    # benchmark terminates, with the scale reported in the JSON.
+    if on_tpu:
+        log2m, B, steps, key_len = 32, 1 << 20, 32, 16
+    else:
+        log2m, B, steps, key_len = 26, 1 << 16, 8, 16
+    config = FilterConfig(m=1 << log2m, k=7, key_len=key_len)
+    insert = make_insert_fn(config)
+    query = make_query_fn(config)
+    lengths = jnp.full((B,), key_len, jnp.int32)
+
+    def step(bits, seed):
+        keys = jax.random.bits(jax.random.key(seed), (B, key_len), jnp.uint8)
+        bits = insert(bits, keys, lengths)
+        hits = query(bits, keys, lengths)
+        return bits, jnp.sum(hits.astype(jnp.uint32))
+
+    step_jit = jax.jit(step, donate_argnums=0)
+
+    bits = jnp.zeros((config.n_words,), jnp.uint32)
+    # warmup / compile
+    t0 = time.perf_counter()
+    bits, hits = step_jit(bits, 0)
+    hits.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    assert int(hits) == B, "keys inserted in-step must all be found"
+    bits, _ = step_jit(bits, 1)
+
+    # timed kernel loop (device-resident keys)
+    t0 = time.perf_counter()
+    acc = None
+    for i in range(2, 2 + steps):
+        bits, acc = step_jit(bits, i)
+    acc.block_until_ready()
+    kernel_s = time.perf_counter() - t0
+    keys_per_sec = B * steps / kernel_s
+
+    # end-to-end rate with host-packed keys (the gRPC-server ingest path)
+    rng = np.random.default_rng(0)
+    host_keys = [rng.bytes(key_len) for _ in range(B)]
+    ku8, kl = pack_keys(host_keys, key_len)
+    insert_jit = jax.jit(insert, donate_argnums=0)
+    query_jit = jax.jit(query)
+    bits = insert_jit(bits, ku8, kl)  # compile for this path
+    t0 = time.perf_counter()
+    bits = insert_jit(bits, jnp.asarray(ku8), jnp.asarray(kl))
+    hits = query_jit(bits, jnp.asarray(ku8), jnp.asarray(kl))
+    hits.block_until_ready()
+    e2e_s = time.perf_counter() - t0
+    assert bool(np.asarray(hits).all())
+
+    # FPR sanity at the end state
+    n_inserted = B * (2 + steps + 2)
+    probe = jax.random.bits(jax.random.key(10_000_019), (B, key_len), jnp.uint8)
+    fpr = float(np.asarray(query_jit(bits, probe, lengths)).mean())
+
+    return {
+        "metric": f"batched insert+query keys/sec/chip @ m=2^{log2m}, k=7",
+        "value": round(keys_per_sec),
+        "unit": "keys/sec",
+        "vs_baseline": round(keys_per_sec / BASELINE_TARGET, 6),
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "m": config.m,
+        "k": config.k,
+        "batch": B,
+        "steps": steps,
+        "compile_s": round(compile_s, 2),
+        "kernel_s": round(kernel_s, 4),
+        "e2e_keys_per_sec": round(B / e2e_s),
+        "observed_fpr": fpr,
+        "n_inserted": n_inserted,
+    }
+
+
+def _child_main() -> None:
+    platform = sys.argv[2]
+    result = _run_bench(platform)
+    print("TPUBLOOM_RESULT " + json.dumps(result), flush=True)
+
+
+def _spawn(platform: str, timeout: int):
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    for line in proc.stdout.splitlines():
+        if line.startswith("TPUBLOOM_RESULT "):
+            return json.loads(line[len("TPUBLOOM_RESULT "):]), None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    return None, f"exit {proc.returncode}: {' | '.join(tail)}"
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child_main()
+        return
+    attempts = []
+    result, err = _spawn("tpu", TPU_TIMEOUT_S)
+    if result is None:
+        attempts.append({"platform": "tpu", "error": err})
+        result, err = _spawn("cpu", CPU_TIMEOUT_S)
+    if result is None:
+        attempts.append({"platform": "cpu", "error": err})
+        result = {
+            "metric": "batched insert+query keys/sec/chip @ m=2^32, k=7",
+            "value": 0,
+            "unit": "keys/sec",
+            "vs_baseline": 0.0,
+            "error": attempts,
+        }
+    elif attempts:
+        result["fallback_from"] = attempts
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""The five BASELINE.json benchmark configs as runnable scripts.
+
+Usage: python benchmarks/run.py --config N [--scale F] [--platform cpu|tpu]
+
+Each config prints one JSON line. --scale shrinks key counts (and for
+device configs the filter size) so every config can be smoke-run on the
+1-core CPU backend; --scale 1.0 on a real v5e chip is the acceptance
+matrix (BASELINE.md). Defaults to a small scale on CPU.
+
+| config | workload                                   | pins                         |
+|--------|--------------------------------------------|------------------------------|
+| 1      | 1M random 16B keys, m=10M, k=7             | CPU reference driver (C++)   |
+| 2      | 100M-key URL dedup, m=2^30, k=10           | single-chip batched kernels  |
+| 3      | 1B-key stream, m=2^34, periodic checkpoint | streaming + checkpoint       |
+| 4      | counting insert/delete/query mix, m=2^30   | scatter-add kernel           |
+| 5      | 64-shard array, m=2^36 total               | shard_map + all-reduce-OR    |
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _gen_keys(n: int, nbytes: int = 16, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, size=(n, nbytes), dtype=np.uint8)
+    return raw, np.full(n, nbytes, dtype=np.int32)
+
+
+def config1(scale: float) -> dict:
+    """CPU reference driver (the reference's :ruby-driver role, C++ hot
+    path): 1M keys, m=10M, k=7 — the measured CPU baseline the TPU numbers
+    are compared against."""
+    import numpy as np
+
+    from tpubloom import CPUBloomFilter, FilterConfig, native
+
+    n = int(1_000_000 * scale)
+    cfg = FilterConfig(m=10_000_000, k=7, key_len=16)
+    f = CPUBloomFilter(cfg)  # auto-uses native when built
+    keys_u8, lengths = _gen_keys(n)
+    keys = [bytes(k) for k in keys_u8]
+    t0 = time.perf_counter()
+    f.insert_batch(keys)
+    t_insert = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hits = f.include_batch(keys)
+    t_query = time.perf_counter() - t0
+    assert hits.all()
+    return {
+        "config": 1,
+        "driver": "native-c++" if f.use_native else "numpy",
+        "n": n,
+        "insert_keys_per_sec": round(n / t_insert),
+        "query_keys_per_sec": round(n / t_query),
+        "combined_keys_per_sec": round(n / (t_insert + t_query)),
+    }
+
+
+def config2(scale: float) -> dict:
+    """URL-dedup: batched inserts then mixed-hit queries on one device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpubloom import BloomFilter, FilterConfig
+
+    n = int(100_000_000 * scale)
+    nq = int(10_000_000 * scale)
+    log2m = 30 if scale >= 0.1 else 24
+    cfg = FilterConfig(m=1 << log2m, k=10, key_len=16)
+    f = BloomFilter(cfg)
+    B = min(1 << 20, max(1 << 12, n // 8))
+    t0 = time.perf_counter()
+    done = 0
+    seed = 0
+    lengths = np.full(B, 16, np.int32)
+    while done < n:
+        b = min(B, n - done)
+        ku8 = jax.random.bits(jax.random.key(seed), (B, 16), jnp.uint8)
+        f.insert_arrays(ku8, lengths)  # device-resident keys, no H2D of keys
+        done += b
+        seed += 1
+    f.block_until_ready()
+    t_insert = time.perf_counter() - t0
+    # mixed-hit queries: half present (reuse seed 0 batch), half absent
+    ku8 = np.asarray(jax.random.bits(jax.random.key(0), (B, 16), jnp.uint8))
+    absent = np.asarray(jax.random.bits(jax.random.key(10**6), (B, 16), jnp.uint8))
+    qdone = 0
+    t0 = time.perf_counter()
+    while qdone < nq:
+        f.include_arrays(ku8 if (qdone // B) % 2 == 0 else absent, np.full(B, 16, np.int32))
+        qdone += B
+    f.block_until_ready()
+    t_query = time.perf_counter() - t0
+    return {
+        "config": 2,
+        "m": cfg.m,
+        "n_insert": n,
+        "n_query": qdone,
+        "insert_keys_per_sec": round(n / t_insert),
+        "query_keys_per_sec": round(qdone / t_query),
+        "fill_ratio": round(f.fill_ratio(), 4),
+    }
+
+
+def config3(scale: float) -> dict:
+    """Streaming insert with periodic checkpoints (tmp-dir file sink)."""
+    import tempfile
+
+    from tpubloom import BloomFilter, FilterConfig
+    from tpubloom import checkpoint as ckpt
+    from tpubloom.parallel.pipeline import StreamInserter
+
+    n = int(1_000_000_000 * scale)
+    log2m = 34 if scale >= 0.1 else 24
+    cfg = FilterConfig(m=1 << log2m, k=7, key_len=28, key_name="stream-bench")
+    f = BloomFilter(cfg)
+    with tempfile.TemporaryDirectory() as td:
+        sink = ckpt.FileSink(td)
+        ins = StreamInserter(
+            f, batch_size=1 << 16, sink=sink, checkpoint_every=max(n // 10, 1 << 16)
+        )
+        t0 = time.perf_counter()
+        stats = ins.run((b"warc-record-%014d" % i for i in range(n)))
+        elapsed = time.perf_counter() - t0
+        ins.close()
+        return {
+            "config": 3,
+            "m": cfg.m,
+            "n": n,
+            "stream_keys_per_sec": round(n / elapsed),
+            "checkpoints_written": ins.checkpointer.checkpoints_written,
+        }
+
+
+def config4(scale: float) -> dict:
+    """Counting filter insert/delete/query mix."""
+    import numpy as np
+
+    from tpubloom import CountingBloomFilter, FilterConfig
+
+    n = int(10_000_000 * scale)
+    log2m = 30 if scale >= 0.1 else 22
+    cfg = FilterConfig(m=1 << log2m, k=7, key_len=16, counting=True)
+    f = CountingBloomFilter(cfg)
+    keys_u8, _ = _gen_keys(n)
+    keys = [bytes(k) for k in keys_u8]
+    half = keys[: n // 2]
+    t0 = time.perf_counter()
+    f.insert_batch(keys)
+    f.delete_batch(half)
+    hits = f.include_batch(keys)
+    elapsed = time.perf_counter() - t0
+    assert hits[n // 2 :].all()
+    return {
+        "config": 4,
+        "m": cfg.m,
+        "ops": 2 * n + n // 2,
+        "ops_per_sec": round((2 * n + n // 2) / elapsed),
+    }
+
+
+def config5(scale: float) -> dict:
+    """64-shard filter array over the available mesh."""
+    import jax
+    import numpy as np
+
+    from tpubloom import FilterConfig
+    from tpubloom.parallel.sharded import ShardedBloomFilter
+
+    n = int(10_000_000 * scale)
+    n_dev = len(jax.devices())
+    log2m = 36 if scale >= 0.1 and n_dev >= 8 else 24
+    cfg = FilterConfig(m=1 << log2m, k=7, key_len=16, shards=64)
+    f = ShardedBloomFilter(cfg)
+    keys_u8, lengths = _gen_keys(min(n, 1 << 18))
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        f.insert_arrays(keys_u8, lengths)  # idempotent re-insert: rate only
+        done += len(keys_u8)
+    f.block_until_ready()
+    t_insert = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hits = np.asarray(f.include_arrays(keys_u8, lengths))
+    t_query = time.perf_counter() - t0
+    assert hits.all()
+    return {
+        "config": 5,
+        "m": cfg.m,
+        "shards": 64,
+        "devices": n_dev,
+        "insert_keys_per_sec": round(done / t_insert),
+        "query_keys_per_sec": round(len(keys_u8) / t_query),
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, required=True, choices=sorted(CONFIGS))
+    ap.add_argument("--scale", type=float, default=None)
+    ap.add_argument("--platform", choices=["cpu", "tpu"], default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu" or (
+        args.platform is None and "cpu" in os.environ.get("JAX_PLATFORMS", "")
+    ):
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() not in ("cpu",)
+    scale = args.scale if args.scale is not None else (1.0 if on_tpu else 0.001)
+
+    result = CONFIGS[args.config](scale)
+    result["scale"] = scale
+    result["platform"] = jax.default_backend()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,16 @@ def test_restore_picks_newest(cfg, tmp_path):
     assert g.include(b"first") and g.include(b"second")
 
 
+def test_restore_preserves_usage_counters(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    f.insert_batch([b"a", b"b", b"c"])
+    f.include_batch([b"a"])
+    ckpt.save(f, sink)
+    g = ckpt.restore(cfg, sink)
+    assert g.n_inserted == 3 and g.n_queried == 1
+
+
 def test_restore_empty_sink(cfg, tmp_path):
     assert ckpt.restore(cfg, ckpt.FileSink(str(tmp_path))) is None
 

@@ -1,0 +1,92 @@
+"""Streaming pipeline + fault injection tests (BASELINE config 3 scaled
+down; SURVEY.md §5 failure row: kill mid-stream, resume, bounded tail loss)."""
+
+import numpy as np
+import pytest
+
+from tpubloom import BloomFilter, FilterConfig
+from tpubloom import checkpoint as ckpt
+from tpubloom.parallel.pipeline import StreamInserter, resume_offset
+
+
+def _key_stream(start, stop):
+    for i in range(start, stop):
+        yield b"stream-key-%012d" % i
+
+
+@pytest.fixture
+def cfg():
+    return FilterConfig(m=1 << 22, k=5, key_len=24, key_name="stream")
+
+
+def test_stream_insert_all_present(cfg):
+    f = BloomFilter(cfg)
+    ins = StreamInserter(f, batch_size=1024)
+    stats = ins.run(_key_stream(0, 10_000))
+    assert stats["inserted"] == 10_000
+    probe = list(_key_stream(0, 10_000))
+    assert f.include_batch(probe).all()
+    assert not f.include_batch([b"absent-%d" % i for i in range(1000)]).any()
+
+
+def test_stream_partial_batches_and_limit(cfg):
+    f = BloomFilter(cfg)
+    ins = StreamInserter(f, batch_size=1000)
+    stats = ins.run(_key_stream(0, 2500), limit=2300)  # forces ragged batches
+    assert stats["inserted"] == 2300
+    assert f.include_batch(list(_key_stream(0, 2300))).all()
+    # reentrant continuation from the same iterator position semantics
+    stats2 = ins.run(_key_stream(2300, 3000))
+    assert stats2["stream_offset"] == 3000
+
+
+def test_periodic_checkpoints_with_offsets(cfg, tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    ins = StreamInserter(f, batch_size=500, sink=sink, checkpoint_every=2000)
+    ins.run(_key_stream(0, 10_000))
+    ins.close(final_checkpoint=True)
+    assert ins.checkpointer.checkpoints_written >= 3
+    g = ckpt.restore(cfg, sink)
+    off = resume_offset(g)
+    assert 0 < off <= 10_000
+    # recovery contract: everything before the recorded offset is present
+    assert g.include_batch(list(_key_stream(0, off))).all()
+
+
+def test_crash_recovery_bounded_tail_loss(cfg, tmp_path):
+    """Simulated crash: the process dies mid-stream (we just stop feeding
+    and drop the objects without a final checkpoint). The newest checkpoint
+    must cover its recorded offset, and replay from there reconverges."""
+    sink = ckpt.FileSink(str(tmp_path))
+    f = BloomFilter(cfg)
+    ins = StreamInserter(f, batch_size=500, sink=sink, checkpoint_every=3000)
+    ins.run(_key_stream(0, 8000))
+    ins.checkpointer.flush()  # let the in-flight write land, then "crash"
+    del f, ins
+
+    g = ckpt.restore(cfg, sink)
+    assert g is not None
+    off = resume_offset(g)
+    assert off >= 3000, "at least one periodic checkpoint must have landed"
+    assert 8000 - off <= 3000 + 500, "tail loss must be bounded by the contract"
+    assert g.include_batch(list(_key_stream(0, off))).all()
+
+    # resume: replay from the offset (idempotent), continue to 12000
+    ins2 = StreamInserter(
+        g, batch_size=500, sink=sink, checkpoint_every=3000, start_offset=off
+    )
+    ins2.run(_key_stream(off, 12_000))
+    ins2.close()
+    assert g.include_batch(list(_key_stream(0, 12_000))).all()
+    assert not g.include_batch([b"no-%d" % i for i in range(500)]).any()
+
+
+def test_stream_into_sharded(cfg):
+    from tpubloom.parallel.sharded import ShardedBloomFilter
+
+    scfg = cfg.replace(shards=8, key_name="stream-sharded")
+    f = ShardedBloomFilter(scfg)
+    ins = StreamInserter(f, batch_size=512)
+    ins.run(_key_stream(0, 4000))
+    assert f.include_batch(list(_key_stream(0, 4000))).all()

@@ -85,6 +85,15 @@ def test_errors(server):
     ]
 
 
+def test_exist_ok_config_mismatch_rejected(server):
+    client, _, _ = server
+    client.create_filter("cfgchk", config={"m": 1 << 16, "k": 4})
+    with pytest.raises(BloomServiceError, match="CONFIG_MISMATCH"):
+        client.create_filter("cfgchk", config={"m": 1 << 18, "k": 4}, exist_ok=True)
+    resp = client.create_filter("cfgchk", config={"m": 1 << 16, "k": 4}, exist_ok=True)
+    assert resp["existed"] and resp["config"]["m"] == 1 << 16
+
+
 def test_checkpoint_restart_cycle(server):
     """Server restart restores the newest checkpoint (SURVEY.md §5 failure
     row: server restart -> restore newest checkpoint)."""

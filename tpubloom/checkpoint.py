@@ -44,7 +44,9 @@ MAGIC = b"TPUBLOOM1\n"
 _CKPT_RE = re.compile(r"^(?P<name>.+)\.(?P<seq>\d{12,})\.ckpt$")
 
 
-def _serialize(config: FilterConfig, seq: int, words: np.ndarray) -> bytes:
+def _serialize(
+    config: FilterConfig, seq: int, words: np.ndarray, extra: Optional[dict] = None
+) -> bytes:
     """Self-describing checkpoint: magic + json header + payload.
 
     Plain filters store the payload in Redis-bitmap byte order so the blob
@@ -65,6 +67,7 @@ def _serialize(config: FilterConfig, seq: int, words: np.ndarray) -> bytes:
             "seq": seq,
             "format": fmt,
             "time": time.time(),
+            "extra": extra or {},
         }
     ).encode()
     return MAGIC + len(header).to_bytes(8, "little") + header + payload
@@ -178,11 +181,20 @@ class RedisSink:
         self._client.close()
 
 
-def save(filter_obj, sink, *, seq: Optional[int] = None) -> int:
+def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] = None) -> int:
     """Synchronous snapshot of any filter (plain/counting/sharded)."""
     seq = seq if seq is not None else int(time.time() * 1000)
     words = np.asarray(filter_obj.words)
-    sink.put(filter_obj.config.key_name, seq, _serialize(filter_obj.config, seq, words))
+    full_extra = {
+        "n_inserted": getattr(filter_obj, "n_inserted", 0),
+        "n_queried": getattr(filter_obj, "n_queried", 0),
+        **(extra or {}),
+    }
+    sink.put(
+        filter_obj.config.key_name,
+        seq,
+        _serialize(filter_obj.config, seq, words, full_extra),
+    )
     return seq
 
 
@@ -231,6 +243,9 @@ def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
         f = BloomFilter(config)
         f.words = jnp.asarray(words)
     f._restored_seq = header["seq"]
+    f._restored_meta = header.get("extra", {})
+    f.n_inserted = int(f._restored_meta.get("n_inserted", 0))
+    f.n_queried = int(f._restored_meta.get("n_queried", 0))
     return f
 
 
@@ -245,10 +260,15 @@ class AsyncCheckpointer:
     periodic checkpointing with bounded tail loss on crash).
     """
 
-    def __init__(self, filter_obj, sink, *, every_n_inserts: int = 0):
+    def __init__(self, filter_obj, sink, *, every_n_inserts: int = 0, meta_fn=None):
+        """``meta_fn() -> dict`` (optional) is sampled at trigger time and
+        stored in the checkpoint header's ``extra`` field — the streaming
+        pipeline records its stream offset this way so resume knows where
+        to replay from."""
         self.filter = filter_obj
         self.sink = sink
         self.every_n_inserts = every_n_inserts
+        self.meta_fn = meta_fn
         self._since_last = 0
         # Millisecond-epoch base keeps sequence numbers monotonic across
         # process restarts (restore picks the max seq in the sink).
@@ -267,10 +287,10 @@ class AsyncCheckpointer:
             item = self._queue.get()
             if item is None:
                 return
-            seq, words = item
+            seq, words, extra = item
             try:
                 # np.asarray blocks until the async D2H copy lands.
-                blob = _serialize(self.filter.config, seq, np.asarray(words))
+                blob = _serialize(self.filter.config, seq, np.asarray(words), extra)
                 self.sink.put(self.filter.config.key_name, seq, blob)
                 self.checkpoints_written += 1
                 self.last_error = None  # a success clears a transient failure
@@ -297,6 +317,13 @@ class AsyncCheckpointer:
             self._busy.set()
             self._seq = max(self._seq + 1, int(time.time() * 1000))
             words = self.filter.words
+            # always record usage counters so restore can rebuild stats
+            extra = {
+                "n_inserted": getattr(self.filter, "n_inserted", 0),
+                "n_queried": getattr(self.filter, "n_queried", 0),
+            }
+            if self.meta_fn:
+                extra.update(self.meta_fn())
         if hasattr(words, "copy_to_host_async"):
             # jax.Array: snapshot to a fresh device buffer (immune to the
             # next insert donating the original), then start the D2H copy.
@@ -306,14 +333,19 @@ class AsyncCheckpointer:
             words.copy_to_host_async()
         else:
             words = np.array(words, copy=True)
-        self._queue.put((self._seq, words))
+        self._queue.put((self._seq, words, extra))
         return True
 
-    def flush(self, timeout: float = 60.0) -> None:
-        """Block until the in-flight checkpoint (if any) is written."""
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Block until the in-flight checkpoint (if any) is written.
+
+        Returns False if it is still unfinished at ``timeout`` — callers
+        treating a checkpoint as a durability point must check this.
+        """
         deadline = time.time() + timeout
         while self._busy.is_set() and time.time() < deadline:
             time.sleep(0.005)
+        return not self._busy.is_set()
 
     def close(self, *, final_checkpoint: bool = True) -> None:
         if final_checkpoint:

@@ -1,0 +1,131 @@
+"""Streaming insert pipeline — BASELINE config 3 (1B-key streams).
+
+Parity: the reference has no streaming story; its closest tool is Redis
+pipelining of per-key commands (SURVEY.md §2.2 "Streaming/pipeline
+parallel"). The TPU-native equivalent pinned there: a host->device input
+pipeline for billion-key streams with periodic checkpoint overlap.
+
+Mechanics:
+
+* the host packs fixed-size key batches while the device crunches the
+  previous ones — JAX's async dispatch IS the double buffer; the pipeline
+  just avoids synchronizing, with a bounded in-flight window as
+  backpressure so host-side buffers can't pile up;
+* every ``checkpoint_every`` keys the AsyncCheckpointer snapshots the array
+  (HBM copy + async D2H + background write) WITHOUT stalling inserts, and
+  records the stream offset in the checkpoint header;
+* **crash recovery contract** (SURVEY.md §5 failure row): on restart,
+  ``resume_offset`` says where the newest checkpoint cut the stream.
+  Replaying the source from any point <= that offset is safe — scatter-OR
+  is idempotent, so at-least-once delivery converges to the same bits —
+  and everything before the offset is guaranteed present. Tail loss is
+  bounded by ``checkpoint_every`` + one in-flight batch window.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from tpubloom.checkpoint import AsyncCheckpointer
+from tpubloom.utils.packing import pack_keys
+
+
+class StreamInserter:
+    """Feed an unbounded key stream into a filter at full device rate."""
+
+    def __init__(
+        self,
+        filter_obj,
+        *,
+        batch_size: int = 1 << 16,
+        sink=None,
+        checkpoint_every: int = 0,
+        max_in_flight: int = 8,
+        start_offset: int = 0,
+    ):
+        self.filter = filter_obj
+        self.batch_size = batch_size
+        self.max_in_flight = max_in_flight
+        self.consumed = start_offset  # keys consumed from the stream origin
+        self._dispatched_since_sync = 0
+        self.checkpointer: Optional[AsyncCheckpointer] = None
+        if sink is not None and checkpoint_every:
+            # meta_fn snapshots the offset at trigger time, under the same
+            # control flow as inserts (run() is single-threaded), so the
+            # recorded offset is consistent with the snapshotted bits.
+            self.checkpointer = AsyncCheckpointer(
+                filter_obj,
+                sink,
+                every_n_inserts=checkpoint_every,
+                meta_fn=lambda: {"stream_offset": self._synced_offset()},
+            )
+
+    def _synced_offset(self) -> int:
+        """Offset fully materialized on device at snapshot time.
+
+        Everything dispatched is captured by the snapshot: the HBM copy in
+        trigger() is enqueued AFTER all pending insert kernels on the same
+        device stream, so `consumed` (all keys handed to the device) is the
+        safe offset.
+        """
+        return self.consumed
+
+    def run(self, keys: Iterable[bytes], *, limit: Optional[int] = None) -> dict:
+        """Consume the stream (optionally at most ``limit`` keys). Returns
+        run stats. Reentrant: call again to continue the same stream."""
+        it: Iterator[bytes] = iter(keys)
+        batch: list = []
+        inserted = 0
+        while True:
+            batch.clear()
+            budget = self.batch_size
+            if limit is not None:
+                budget = min(budget, limit - inserted)
+                if budget <= 0:
+                    break
+            for key in it:
+                batch.append(key)
+                if len(batch) >= budget:
+                    break
+            if not batch:
+                break
+            keys_u8, lengths = pack_keys(
+                batch, self.filter.config.key_len,
+                key_policy=self.filter.config.key_policy,
+            )
+            if len(batch) < self.batch_size:  # static-shape padding
+                pad = self.batch_size - len(batch)
+                keys_u8 = np.pad(keys_u8, ((0, pad), (0, 0)))
+                lengths = np.pad(lengths, (0, pad), constant_values=-1)
+            self.filter.insert_arrays(keys_u8, lengths)
+            inserted += len(batch)
+            self.consumed += len(batch)
+            self._dispatched_since_sync += 1
+            if self._dispatched_since_sync >= self.max_in_flight:
+                # backpressure: bound the async dispatch queue
+                self.filter.block_until_ready()
+                self._dispatched_since_sync = 0
+            if self.checkpointer:
+                self.checkpointer.notify_inserts(len(batch))
+        self.filter.block_until_ready()
+        return {
+            "inserted": inserted,
+            "stream_offset": self.consumed,
+            "checkpoints_written": (
+                self.checkpointer.checkpoints_written if self.checkpointer else 0
+            ),
+        }
+
+    def close(self, *, final_checkpoint: bool = True) -> None:
+        if self.checkpointer:
+            self.checkpointer.close(final_checkpoint=final_checkpoint)
+
+
+def resume_offset(restored_filter) -> int:
+    """Stream offset recorded in the checkpoint a filter was restored from
+    (0 if none): restart the source at or before this offset and re-run —
+    idempotent inserts make the replay safe."""
+    meta = getattr(restored_filter, "_restored_meta", None) or {}
+    return int(meta.get("stream_offset", 0))

@@ -87,18 +87,35 @@ class BloomService:
     def CreateFilter(self, req: dict) -> dict:
         name = req["name"]
         with self._lock:
-            if name in self._filters:
-                if req.get("exist_ok", False):
-                    return {"ok": True, "existed": True}
-                raise protocol.BloomServiceError(
-                    "ALREADY_EXISTS", f"filter {name!r} exists"
-                )
             if "config" in req:
                 config = FilterConfig.from_dict({**req["config"], "key_name": name})
             else:
                 config = FilterConfig.from_capacity(
                     req["capacity"], req["error_rate"], key_name=name,
                     **req.get("options", {}),
+                )
+            if name in self._filters:
+                if req.get("exist_ok", False):
+                    # attaching to an existing filter must mean the SAME
+                    # filter — a silent mismatch would e.g. pour 1e8 keys
+                    # into a 1e3-capacity array while the caller believes
+                    # it requested 1% FPR.
+                    existing = self._filters[name].filter.config
+                    for field in ("m", "k", "seed", "counting", "shards", "key_len"):
+                        if getattr(existing, field) != getattr(config, field):
+                            raise protocol.BloomServiceError(
+                                "CONFIG_MISMATCH",
+                                f"filter {name!r} exists with {field}="
+                                f"{getattr(existing, field)}, requested "
+                                f"{getattr(config, field)}",
+                            )
+                    return {
+                        "ok": True,
+                        "existed": True,
+                        "config": existing.to_dict(),
+                    }
+                raise protocol.BloomServiceError(
+                    "ALREADY_EXISTS", f"filter {name!r} exists"
                 )
             sink = self._sink_factory(config)
             restored = None
@@ -134,11 +151,15 @@ class BloomService:
         if mf is None:
             return {"ok": True, "existed": False}
         if mf.checkpointer:
-            mf.checkpointer.close(final_checkpoint=req.get("final_checkpoint", True))
+            with mf.lock:  # exclude donating inserts during the final snapshot
+                mf.checkpointer.close(
+                    final_checkpoint=req.get("final_checkpoint", True)
+                )
         return {"ok": True, "existed": True}
 
     def ListFilters(self, req: dict) -> dict:
-        return {"ok": True, "filters": sorted(self._filters)}
+        with self._lock:
+            return {"ok": True, "filters": sorted(self._filters)}
 
     def InsertBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -193,7 +214,19 @@ class BloomService:
         with mf.lock:  # snapshot copy must not race a donating insert
             triggered = mf.checkpointer.trigger()
         if req.get("wait", True):
-            mf.checkpointer.flush()
+            if not mf.checkpointer.flush():
+                raise protocol.BloomServiceError(
+                    "CKPT_TIMEOUT", "in-flight checkpoint write did not finish"
+                )
+            if not triggered:
+                # an older snapshot was in flight — it predates this call's
+                # durability point, so take a fresh one now that it's done.
+                with mf.lock:
+                    triggered = mf.checkpointer.trigger()
+                if not mf.checkpointer.flush():
+                    raise protocol.BloomServiceError(
+                        "CKPT_TIMEOUT", "checkpoint write did not finish"
+                    )
             if mf.checkpointer.last_error is not None:
                 raise protocol.BloomServiceError(
                     "CKPT_FAILED", repr(mf.checkpointer.last_error)
@@ -202,8 +235,10 @@ class BloomService:
 
     def shutdown(self) -> None:
         with self._lock:
-            for mf in self._filters.values():
-                if mf.checkpointer:
+            filters = list(self._filters.values())
+        for mf in filters:
+            if mf.checkpointer:
+                with mf.lock:  # let in-flight inserts drain first
                     mf.checkpointer.close(final_checkpoint=True)
 
 

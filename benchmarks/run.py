@@ -87,7 +87,12 @@ def config2(scale: float) -> dict:
     while done < n:
         b = min(B, n - done)
         ku8 = jax.random.bits(jax.random.key(seed), (B, 16), jnp.uint8)
-        f.insert_arrays(ku8, lengths)  # device-resident keys, no H2D of keys
+        if b < B:  # mask the tail so exactly n keys land in the filter
+            lb = lengths.copy()
+            lb[b:] = -1
+            f.insert_arrays(ku8, lb, n_valid=b)
+        else:
+            f.insert_arrays(ku8, lengths)  # device-resident keys, no H2D
         done += b
         seed += 1
     f.block_until_ready()

@@ -1,0 +1,104 @@
+"""Scalable (layered) bloom filter tests — growth policy, FPR bound, and
+device-vs-CPU-oracle parity (SURVEY.md §2.3 scalable/layered variant)."""
+
+import numpy as np
+import pytest
+
+from tpubloom.config import FilterConfig
+from tpubloom.scalable import (
+    CPUScalableBloomFilter,
+    ScalableBloomFilter,
+    layer_config,
+)
+
+
+def _rand_keys(n, rng, nbytes=16):
+    return [rng.bytes(nbytes) for _ in range(n)]
+
+
+def test_layer_config_policy():
+    base = FilterConfig(m=64, k=1, seed=1234)
+    c0, cap0 = layer_config(base, 1000, 0.01, 0)
+    c1, cap1 = layer_config(base, 1000, 0.01, 1)
+    c2, cap2 = layer_config(base, 1000, 0.01, 2)
+    assert (cap0, cap1, cap2) == (1000, 2000, 4000)
+    # tightening halves the per-layer error rate -> more bits per key and
+    # larger k on deeper layers
+    assert c1.m >= c0.m and c2.m >= c1.m
+    assert c2.k >= c0.k
+    # layer seeds differ (independent hash families)
+    assert len({c0.seed, c1.seed, c2.seed}) == 3
+    # m is a power of two (device fast path)
+    for c in (c0, c1, c2):
+        assert c.m & (c.m - 1) == 0
+
+
+def test_no_false_negatives_across_growth():
+    rng = np.random.default_rng(0)
+    f = ScalableBloomFilter(500, 0.01)
+    keys = _rand_keys(2600, rng)  # forces several growths past 500/1000 caps
+    f.insert_batch(keys)
+    assert f.n_layers >= 3
+    assert f.include_batch(keys).all(), "scalable filter lost keys across layers"
+
+
+def test_growth_splits_batches_at_capacity():
+    rng = np.random.default_rng(1)
+    f = ScalableBloomFilter(100, 0.01)
+    f.insert_batch(_rand_keys(95, rng))
+    assert f.n_layers == 1
+    f.insert_batch(_rand_keys(10, rng))  # 95 + 10 > 100 -> split, push layer
+    assert f.n_layers == 2
+    s = f.stats()
+    assert s["count_current_layer"] == 5
+    assert s["capacity_current_layer"] == 200
+
+
+def test_compound_fpr_within_bound():
+    rng = np.random.default_rng(2)
+    f = ScalableBloomFilter(2000, 0.01)
+    f.insert_batch(_rand_keys(7000, rng))  # 3+ layers, all at design load
+    absent = _rand_keys(20000, rng)
+    fpr = f.include_batch(absent).mean()
+    # compound bound: sum p*r^i < p/(1-r) = 2% for r=0.5; allow sampling slack
+    assert fpr < 2.5 * f.compound_fpr_bound() + 0.005
+    assert f.compound_fpr_bound() < 0.02
+
+
+def test_parity_device_vs_cpu_oracle():
+    """Same inserts -> identical layer stacks and identical membership."""
+    rng = np.random.default_rng(3)
+    keys = _rand_keys(1300, rng) + [b"", b"x", b"tpubloom-scal"]
+    dev = ScalableBloomFilter(400, 0.02)
+    cpu = CPUScalableBloomFilter(400, 0.02, use_native=False)
+    for start in range(0, len(keys), 250):  # staggered batches
+        chunk = keys[start : start + 250]
+        dev.insert_batch(chunk)
+        cpu.insert_batch(chunk)
+    assert dev.n_layers == cpu.n_layers
+    for dl, cl in zip(dev.layers, cpu.layers):
+        assert dl.config == cl.config
+        np.testing.assert_array_equal(np.asarray(dl.words), cl.words)
+    probe = keys + _rand_keys(1500, rng)
+    np.testing.assert_array_equal(dev.include_batch(probe), cpu.include_batch(probe))
+
+
+def test_clear_resets_to_single_layer():
+    rng = np.random.default_rng(4)
+    f = ScalableBloomFilter(100, 0.01)
+    f.insert_batch(_rand_keys(350, rng))
+    assert f.n_layers > 1
+    f.clear()
+    assert f.n_layers == 1 and f.n_inserted == 0
+    assert not f.include_batch(_rand_keys(50, rng)).any()
+
+
+def test_invalid_params_rejected():
+    with pytest.raises(ValueError):
+        ScalableBloomFilter(0, 0.01)
+    with pytest.raises(ValueError):
+        ScalableBloomFilter(100, 1.5)
+    with pytest.raises(ValueError):
+        ScalableBloomFilter(100, 0.01, growth=1)
+    with pytest.raises(ValueError):
+        ScalableBloomFilter(100, 0.01, tightening=1.0)

@@ -22,6 +22,7 @@ from tpubloom.params import optimal_m_k, theoretical_fpr
 from tpubloom.config import FilterConfig
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.cpu_ref import CPUBloomFilter
+from tpubloom.scalable import CPUScalableBloomFilter, ScalableBloomFilter
 
 __all__ = [
     "__version__",
@@ -31,4 +32,6 @@ __all__ = [
     "BloomFilter",
     "CountingBloomFilter",
     "CPUBloomFilter",
+    "ScalableBloomFilter",
+    "CPUScalableBloomFilter",
 ]

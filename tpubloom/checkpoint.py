@@ -37,7 +37,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from tpubloom.config import FilterConfig
+from tpubloom.config import FilterConfig, identity_mismatch
 
 MAGIC = b"TPUBLOOM1\n"
 
@@ -181,15 +181,20 @@ class RedisSink:
         self._client.close()
 
 
+def _usage_extra(filter_obj) -> dict:
+    """Usage counters recorded in every checkpoint so restore can rebuild
+    server stats."""
+    return {
+        "n_inserted": getattr(filter_obj, "n_inserted", 0),
+        "n_queried": getattr(filter_obj, "n_queried", 0),
+    }
+
+
 def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] = None) -> int:
     """Synchronous snapshot of any filter (plain/counting/sharded)."""
     seq = seq if seq is not None else int(time.time() * 1000)
     words = np.asarray(filter_obj.words)
-    full_extra = {
-        "n_inserted": getattr(filter_obj, "n_inserted", 0),
-        "n_queried": getattr(filter_obj, "n_queried", 0),
-        **(extra or {}),
-    }
+    full_extra = {**_usage_extra(filter_obj), **(extra or {})}
     sink.put(
         filter_obj.config.key_name,
         seq,
@@ -211,15 +216,12 @@ def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
         return None
     header, payload = _deserialize(blob)
     saved = header["config"]
-    # shards is identity-relevant: the sharded payload is shard-major with
-    # per-shard-local positions, so a different shard count reinterprets
-    # the same bytes under a different layout and hash mapping.
-    for field in ("m", "k", "seed", "counting", "shards"):
-        if saved[field] != getattr(config, field):
-            raise ValueError(
-                f"checkpoint/config mismatch on {field}: "
-                f"saved={saved[field]} requested={getattr(config, field)}"
-            )
+    field = identity_mismatch(saved, config)
+    if field is not None:
+        raise ValueError(
+            f"checkpoint/config mismatch on {field}: "
+            f"saved={saved[field]} requested={getattr(config, field)}"
+        )
     words = payload_to_words(config, header, payload)
     if config.counting:
         from tpubloom.filter import CountingBloomFilter
@@ -317,11 +319,7 @@ class AsyncCheckpointer:
             self._busy.set()
             self._seq = max(self._seq + 1, int(time.time() * 1000))
             words = self.filter.words
-            # always record usage counters so restore can rebuild stats
-            extra = {
-                "n_inserted": getattr(self.filter, "n_inserted", 0),
-                "n_queried": getattr(self.filter, "n_queried", 0),
-            }
+            extra = _usage_extra(self.filter)
             if self.meta_fn:
                 extra.update(self.meta_fn())
         if hasattr(words, "copy_to_host_async"):
@@ -347,14 +345,26 @@ class AsyncCheckpointer:
             time.sleep(0.005)
         return not self._busy.is_set()
 
-    def close(self, *, final_checkpoint: bool = True) -> None:
+    def close(self, *, final_checkpoint: bool = True) -> bool:
+        """Stop the worker; with ``final_checkpoint`` take one last snapshot.
+
+        Returns True iff the final snapshot verifiably landed in the sink
+        (always True when ``final_checkpoint=False``). Callers using close as
+        a durability point (DropFilter, server shutdown) must check this —
+        silently dropping the filter after a missed final write would lose
+        the tail of the stream without anyone knowing.
+        """
+        ok = True
         if final_checkpoint:
-            self.flush()
-            self.trigger()
-            self.flush()
+            ok = self.flush()  # drain any in-flight write first
+            ok = self.trigger() and ok
+            ok = self.flush() and ok
         self._stop = True
         self._queue.put(None)
         self._worker.join(timeout=30)
+        if final_checkpoint and self.last_error is not None:
+            ok = False
+        return ok
 
     @property
     def seq(self) -> int:

@@ -19,6 +19,25 @@ from tpubloom.params import optimal_m_k, round_up_pow2
 #: identity — two filters interoperate only if (m, k, seed, hash spec) match).
 DEFAULT_SEED = 0x9747B28C
 
+#: Fields that define a filter's *semantic identity*: two configs agreeing on
+#: these produce interchangeable bit arrays (positions are only portable
+#: between identical hash configs; shards is identity-relevant because the
+#: sharded payload is shard-major with per-shard-local positions).
+IDENTITY_FIELDS = ("m", "k", "seed", "counting", "shards")
+
+
+def identity_mismatch(a, b, fields=IDENTITY_FIELDS):
+    """First identity field on which configs ``a`` and ``b`` disagree, or
+    None if they match. ``a``/``b`` may be FilterConfig or plain dicts."""
+
+    def get(c, f):
+        return c[f] if isinstance(c, dict) else getattr(c, f)
+
+    for field in fields:
+        if get(a, field) != get(b, field):
+            return field
+    return None
+
 
 @dataclasses.dataclass(frozen=True)
 class FilterConfig:

@@ -168,9 +168,12 @@ class BloomFilter(_FilterBase):
 
     # pre-packed device-array API (bench / server / streaming path)
 
-    def insert_arrays(self, keys_u8, lengths) -> None:
+    def insert_arrays(self, keys_u8, lengths, *, n_valid: int | None = None) -> None:
+        """``n_valid`` = true key count when the batch carries static-shape
+        padding (lengths = -1 rows set no bits but must not inflate
+        ``n_inserted`` — it is persisted into checkpoints)."""
         self.words = self._insert(self.words, keys_u8, lengths)
-        self.n_inserted += int(keys_u8.shape[0])
+        self.n_inserted += int(keys_u8.shape[0]) if n_valid is None else n_valid
 
     def include_arrays(self, keys_u8, lengths):
         self.n_queried += int(keys_u8.shape[0])

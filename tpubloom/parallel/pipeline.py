@@ -99,7 +99,7 @@ class StreamInserter:
                 pad = self.batch_size - len(batch)
                 keys_u8 = np.pad(keys_u8, ((0, pad), (0, 0)))
                 lengths = np.pad(lengths, (0, pad), constant_values=-1)
-            self.filter.insert_arrays(keys_u8, lengths)
+            self.filter.insert_arrays(keys_u8, lengths, n_valid=len(batch))
             inserted += len(batch)
             self.consumed += len(batch)
             self._dispatched_since_sync += 1

@@ -182,9 +182,11 @@ class ShardedBloomFilter(_FilterBase):
         self.n_queried += B
         return out[:B]
 
-    def insert_arrays(self, keys_u8, lengths) -> None:
+    def insert_arrays(self, keys_u8, lengths, *, n_valid: int | None = None) -> None:
+        """``n_valid`` = true key count when the batch carries static-shape
+        padding (see BloomFilter.insert_arrays)."""
         self.words = self._insert(self.words, keys_u8, lengths)
-        self.n_inserted += int(keys_u8.shape[0])
+        self.n_inserted += int(keys_u8.shape[0]) if n_valid is None else n_valid
 
     def include_arrays(self, keys_u8, lengths):
         self.n_queried += int(keys_u8.shape[0])

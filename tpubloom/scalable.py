@@ -1,0 +1,229 @@
+"""Scalable (layered) bloom filter — grows when full, FPR stays bounded.
+
+Parity: SURVEY.md §2.3 lists the scalable/layered filter as a capability of
+the reference's Lua lineage (the README credits ErikDubbelboer's
+redis-lua-scaling-bloom-filter scripts [PK]). The canonical design is the
+scalable bloom filter of Almeida, Baquero, Preguiça & Hutchison (2007):
+
+* a stack of plain bloom-filter *layers*; layer ``i`` holds
+  ``capacity · growth^i`` keys at error rate ``error_rate · tightening^i``;
+* inserts go to the newest layer; when it reaches capacity a fresh, larger,
+  tighter layer is pushed;
+* membership is the OR over layers, so the compound false-positive rate is
+  bounded by ``sum_i p·r^i  <  error_rate / (1 - tightening)``.
+
+TPU-first mechanics: each layer is an independent device-resident
+:class:`~tpubloom.filter.BloomFilter` (packed uint32 array + jitted
+scatter-OR/gather-AND kernels), deliberately *not* one fused array — layers
+have different m and appear at data-dependent times, which would force
+recompilation if baked into one kernel; a Python loop over a handful of
+layers (layer count grows only logarithmically in total keys) keeps every
+per-layer kernel static-shaped and cached. Each layer derives its own hash
+seed so layer memberships are independent.
+
+The growth policy lives in one class parameterized by a layer factory, so
+the device filter and the CPU oracle (:class:`tpubloom.cpu_ref.CPUBloomFilter`)
+share the exact same layering decisions — tests pin them against each other.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.params import optimal_m_k, round_up_pow2
+
+
+#: Seed derivation for layer i (any fixed odd constant; part of the filter's
+#: identity like FilterConfig.seed itself): seed_i = (seed + i·LAYER_SEED_STRIDE) mod 2^32.
+LAYER_SEED_STRIDE = 0x61C88647  # 2^32 / golden ratio, odd
+
+
+def layer_config(
+    base: FilterConfig,
+    capacity: int,
+    error_rate: float,
+    layer: int,
+    *,
+    growth: int = 2,
+    tightening: float = 0.5,
+) -> tuple[FilterConfig, int]:
+    """Config + capacity of layer ``layer`` under the scalable policy.
+
+    Returns ``(config, layer_capacity)``. Deterministic in its inputs, so two
+    implementations (device / CPU oracle) built with the same arguments
+    produce interchangeable layer stacks.
+    """
+    cap_i = capacity * (growth ** layer)
+    p_i = error_rate * (tightening ** layer)
+    m, k = optimal_m_k(cap_i, p_i)
+    m = round_up_pow2(m)
+    seed_i = (base.seed + layer * LAYER_SEED_STRIDE) % (1 << 32)
+    return base.replace(m=m, k=k, seed=seed_i, shards=1), cap_i
+
+
+class _ScalableCore:
+    """Layer-stack growth policy, shared by device and CPU variants."""
+
+    def __init__(
+        self,
+        make_layer: Callable[[FilterConfig], object],
+        config: FilterConfig,
+        capacity: int,
+        error_rate: float,
+        *,
+        growth: int = 2,
+        tightening: float = 0.5,
+    ):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if not (0.0 < error_rate < 1.0):
+            raise ValueError(f"error_rate must be in (0, 1), got {error_rate}")
+        if growth < 2:
+            raise ValueError(f"growth must be >= 2, got {growth}")
+        if not (0.0 < tightening < 1.0):
+            raise ValueError(f"tightening must be in (0, 1), got {tightening}")
+        self._make_layer = make_layer
+        self.base_config = config
+        self.capacity = capacity
+        self.error_rate = error_rate
+        self.growth = growth
+        self.tightening = tightening
+        self.layers: list = []
+        self._layer_caps: list[int] = []
+        self._layer_counts: list[int] = []
+        self.n_inserted = 0
+        self._push_layer()
+
+    # -- growth -------------------------------------------------------------
+
+    def _push_layer(self) -> None:
+        cfg, cap = layer_config(
+            self.base_config,
+            self.capacity,
+            self.error_rate,
+            len(self.layers),
+            growth=self.growth,
+            tightening=self.tightening,
+        )
+        self.layers.append(self._make_layer(cfg))
+        self._layer_caps.append(cap)
+        self._layer_counts.append(0)
+
+    def _room(self) -> int:
+        return self._layer_caps[-1] - self._layer_counts[-1]
+
+    # -- reference-parity API ----------------------------------------------
+
+    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
+        """Insert, splitting across a growth boundary so every layer stays
+        within its design capacity (the FPR bound depends on it)."""
+        keys = list(keys)
+        start = 0
+        while start < len(keys):
+            room = self._room()
+            if room <= 0:
+                self._push_layer()
+                continue
+            chunk = keys[start : start + room]
+            self.layers[-1].insert_batch(chunk)
+            self._layer_counts[-1] += len(chunk)
+            self.n_inserted += len(chunk)
+            start += len(chunk)
+
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        """Membership = OR over layers (any layer claiming the key)."""
+        out = np.zeros(len(keys), dtype=bool)
+        for layer in self.layers:
+            out |= np.asarray(layer.include_batch(keys))
+        return out
+
+    def insert(self, key: bytes | str) -> None:
+        self.insert_batch([key])
+
+    def include(self, key: bytes | str) -> bool:
+        return bool(self.include_batch([key])[0])
+
+    __contains__ = include
+
+    def clear(self) -> None:
+        self.layers = []
+        self._layer_caps = []
+        self._layer_counts = []
+        self.n_inserted = 0
+        self._push_layer()
+
+    # -- observability ------------------------------------------------------
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+    def compound_fpr_bound(self) -> float:
+        """Design-time upper bound on the compound FPR: sum of layer rates."""
+        return sum(
+            self.error_rate * self.tightening**i for i in range(len(self.layers))
+        )
+
+    def stats(self) -> dict:
+        return {
+            "n_layers": self.n_layers,
+            "n_inserted": self.n_inserted,
+            "capacity_current_layer": self._layer_caps[-1],
+            "count_current_layer": self._layer_counts[-1],
+            "total_bits": sum(layer.config.m for layer in self.layers),
+            "compound_fpr_bound": self.compound_fpr_bound(),
+        }
+
+
+class ScalableBloomFilter(_ScalableCore):
+    """Device-resident scalable filter: a stack of TPU BloomFilter layers."""
+
+    def __init__(
+        self,
+        capacity: int,
+        error_rate: float,
+        *,
+        config: FilterConfig | None = None,
+        growth: int = 2,
+        tightening: float = 0.5,
+    ):
+        from tpubloom.filter import BloomFilter
+
+        base = config if config is not None else FilterConfig(m=64, k=1)
+        super().__init__(
+            BloomFilter, base, capacity, error_rate,
+            growth=growth, tightening=tightening,
+        )
+
+    def block_until_ready(self) -> None:
+        for layer in self.layers:
+            layer.block_until_ready()
+
+
+class CPUScalableBloomFilter(_ScalableCore):
+    """CPU-oracle scalable filter: same policy over CPUBloomFilter layers."""
+
+    def __init__(
+        self,
+        capacity: int,
+        error_rate: float,
+        *,
+        config: FilterConfig | None = None,
+        growth: int = 2,
+        tightening: float = 0.5,
+        use_native: bool | None = None,
+    ):
+        from tpubloom.cpu_ref import CPUBloomFilter
+
+        base = config if config is not None else FilterConfig(m=64, k=1)
+
+        def make_layer(cfg: FilterConfig):
+            return CPUBloomFilter(cfg, use_native=use_native)
+
+        super().__init__(
+            make_layer, base, capacity, error_rate,
+            growth=growth, tightening=tightening,
+        )

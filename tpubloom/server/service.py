@@ -32,7 +32,7 @@ import grpc
 import numpy as np
 
 from tpubloom import checkpoint as ckpt
-from tpubloom.config import FilterConfig
+from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.server import protocol
 from tpubloom.server.metrics import Metrics
@@ -84,25 +84,32 @@ class BloomService:
             "filters": len(self._filters),
         }
 
+    @staticmethod
+    def _parse_config(req: dict, name: str) -> FilterConfig:
+        if "config" in req:
+            return FilterConfig.from_dict({**req["config"], "key_name": name})
+        return FilterConfig.from_capacity(
+            req["capacity"], req["error_rate"], key_name=name,
+            **req.get("options", {}),
+        )
+
     def CreateFilter(self, req: dict) -> dict:
         name = req["name"]
         with self._lock:
-            if "config" in req:
-                config = FilterConfig.from_dict({**req["config"], "key_name": name})
-            else:
-                config = FilterConfig.from_capacity(
-                    req["capacity"], req["error_rate"], key_name=name,
-                    **req.get("options", {}),
-                )
             if name in self._filters:
+                existing = self._filters[name].filter.config
                 if req.get("exist_ok", False):
-                    # attaching to an existing filter must mean the SAME
+                    # Attaching to an existing filter must mean the SAME
                     # filter — a silent mismatch would e.g. pour 1e8 keys
                     # into a 1e3-capacity array while the caller believes
-                    # it requested 1% FPR.
-                    existing = self._filters[name].filter.config
-                    for field in ("m", "k", "seed", "counting", "shards", "key_len"):
-                        if getattr(existing, field) != getattr(config, field):
+                    # it requested 1% FPR. A bare attach (no config/capacity
+                    # given) adopts the existing config as-is.
+                    if "config" in req or req.get("capacity") is not None:
+                        config = self._parse_config(req, name)
+                        field = identity_mismatch(
+                            existing, config, IDENTITY_FIELDS + ("key_len",)
+                        )
+                        if field is not None:
                             raise protocol.BloomServiceError(
                                 "CONFIG_MISMATCH",
                                 f"filter {name!r} exists with {field}="
@@ -117,6 +124,7 @@ class BloomService:
                 raise protocol.BloomServiceError(
                     "ALREADY_EXISTS", f"filter {name!r} exists"
                 )
+            config = self._parse_config(req, name)
             sink = self._sink_factory(config)
             restored = None
             if sink is not None and req.get("restore", True):
@@ -151,9 +159,16 @@ class BloomService:
         if mf is None:
             return {"ok": True, "existed": False}
         if mf.checkpointer:
+            final = req.get("final_checkpoint", True)
             with mf.lock:  # exclude donating inserts during the final snapshot
-                mf.checkpointer.close(
-                    final_checkpoint=req.get("final_checkpoint", True)
+                landed = mf.checkpointer.close(final_checkpoint=final)
+            if final and not landed:
+                # the filter is gone from memory either way — the caller
+                # asked for a durability point and must know it was missed
+                raise protocol.BloomServiceError(
+                    "CKPT_FAILED",
+                    "final checkpoint did not land: "
+                    + repr(mf.checkpointer.last_error),
                 )
         return {"ok": True, "existed": True}
 
@@ -235,11 +250,16 @@ class BloomService:
 
     def shutdown(self) -> None:
         with self._lock:
-            filters = list(self._filters.values())
-        for mf in filters:
+            filters = list(self._filters.items())
+        for name, mf in filters:
             if mf.checkpointer:
                 with mf.lock:  # let in-flight inserts drain first
-                    mf.checkpointer.close(final_checkpoint=True)
+                    landed = mf.checkpointer.close(final_checkpoint=True)
+                if not landed:
+                    log.error(
+                        "final checkpoint for filter %r did not land: %r",
+                        name, mf.checkpointer.last_error,
+                    )
 
 
 def _wrap(service: BloomService, method_name: str):

@@ -1,0 +1,32 @@
+"""Tracing/profiling subsystem (SURVEY.md §5 "Tracing/profiling")."""
+
+import glob
+import os
+
+import numpy as np
+
+from tpubloom import BloomFilter, FilterConfig
+from tpubloom.utils import tracing
+
+
+def test_profile_call_produces_trace(tmp_path):
+    config = FilterConfig(m=1 << 16, k=4, key_len=16)
+    f = BloomFilter(config)
+    rng = np.random.default_rng(0)
+    keys = [rng.bytes(16) for _ in range(64)]
+
+    def work():
+        f.insert_batch(keys)
+        return f.include_batch(keys)
+
+    result, trace_dir = tracing.profile_call(work, log_dir=str(tmp_path / "tr"))
+    assert result.all()
+    # jax.profiler writes plugins/profile/<run>/ with xplane/trace files
+    produced = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*"))
+    assert produced, f"no trace artifacts under {trace_dir}"
+
+
+def test_annotate_is_transparent():
+    with tracing.annotate("span", batch=3):
+        x = 1 + 1
+    assert x == 2

@@ -118,9 +118,15 @@ class StreamInserter:
             ),
         }
 
-    def close(self, *, final_checkpoint: bool = True) -> None:
+    def close(self, *, final_checkpoint: bool = True) -> bool:
+        """Flush and stop checkpointing. Returns False when the requested
+        final checkpoint did NOT land — callers using close() as the
+        durability point before discarding the source stream must check it
+        (``checkpointer.last_error`` has the cause). No checkpointer
+        configured -> trivially True."""
         if self.checkpointer:
-            self.checkpointer.close(final_checkpoint=final_checkpoint)
+            return self.checkpointer.close(final_checkpoint=final_checkpoint)
+        return True
 
 
 def resume_offset(restored_filter) -> int:

@@ -202,6 +202,16 @@ class ScalableBloomFilter(_ScalableCore):
         for layer in self.layers:
             layer.block_until_ready()
 
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        """Pack once, query every layer with the shared device arrays
+        (layers share key_len/key_policy; only m/k/seed differ)."""
+        keys = list(keys)
+        keys_u8, lengths, B = self.layers[0]._pack_padded(keys)
+        out = np.zeros(B, dtype=bool)
+        for layer in self.layers:
+            out |= np.asarray(layer.include_arrays(keys_u8, lengths))[:B]
+        return out
+
 
 class CPUScalableBloomFilter(_ScalableCore):
     """CPU-oracle scalable filter: same policy over CPUBloomFilter layers."""

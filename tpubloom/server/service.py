@@ -36,6 +36,7 @@ from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.server import protocol
 from tpubloom.server.metrics import Metrics
+from tpubloom.utils import tracing
 
 log = logging.getLogger("tpubloom.server")
 
@@ -178,7 +179,7 @@ class BloomService:
 
     def InsertBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
-        with mf.lock:
+        with mf.lock, tracing.annotate("InsertBatch", batch=len(req["keys"])):
             mf.filter.insert_batch(req["keys"])
             if mf.checkpointer:
                 mf.checkpointer.notify_inserts(len(req["keys"]))
@@ -187,7 +188,8 @@ class BloomService:
 
     def QueryBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
-        with mf.lock:  # see class docstring: donation makes this mandatory
+        with mf.lock, tracing.annotate("QueryBatch", batch=len(req["keys"])):
+            # see class docstring: donation makes the lock mandatory
             hits = mf.filter.include_batch(req["keys"])
         self.metrics.count("keys_queried", len(req["keys"]))
         return {"ok": True, "hits": np.packbits(hits).tobytes(), "n": len(req["keys"])}

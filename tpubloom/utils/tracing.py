@@ -1,0 +1,78 @@
+"""Kernel tracing/profiling helpers — the SURVEY.md §5 "Tracing/profiling"
+subsystem.
+
+Parity: the reference gem has no tracing; operators use Redis
+SLOWLOG/MONITOR. The TPU-native equivalent pinned by SURVEY.md §5 is
+``jax.profiler`` traces (viewable in Perfetto / XProf / TensorBoard)
+around the insert/query kernels, plus named annotations so individual
+batches show up in the trace timeline.
+
+Usage::
+
+    from tpubloom.utils import tracing
+
+    with tracing.trace("/tmp/tpubloom-trace"):     # whole-session trace
+        with tracing.annotate("insert_batch", batch=len(keys)):
+            f.insert_batch(keys)
+
+    # or one-shot around a callable:
+    result, trace_dir = tracing.profile_call(fn, *args)
+
+The gRPC server wires ``annotate`` around every request so per-request
+spans appear in device traces (tpubloom/server/service.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Any, Callable, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create: bool = True) -> Iterator[str]:
+    """Capture a jax.profiler device+host trace into ``log_dir``.
+
+    The resulting ``plugins/profile/**/*.trace.json.gz`` /
+    ``*.xplane.pb`` files open in Perfetto (ui.perfetto.dev) or
+    TensorBoard's profile plugin.
+    """
+    if create:
+        os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str, **attrs: Any) -> Iterator[None]:
+    """Named span in the profiler timeline (TraceAnnotation).
+
+    ``attrs`` are folded into the span name (TraceAnnotation carries no
+    structured payload) — keep them short, e.g. ``batch=4096``.
+    """
+    if attrs:
+        name = name + "[" + ",".join(f"{k}={v}" for k, v in attrs.items()) + "]"
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def profile_call(
+    fn: Callable[..., Any], *args: Any, log_dir: str | None = None, **kwargs: Any
+) -> tuple[Any, str]:
+    """Run ``fn(*args, **kwargs)`` under a one-shot trace.
+
+    Returns ``(result, trace_dir)``. Blocks on the result so device work
+    lands inside the captured window.
+    """
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="tpubloom-trace-")
+    with trace(log_dir):
+        result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+    return result, log_dir

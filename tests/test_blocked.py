@@ -1,0 +1,198 @@
+"""Blocked (cache-line) bloom filter — device kernels vs CPU oracle.
+
+The blocked layout is the throughput variant (tpubloom.ops.blocked); these
+tests pin its position spec between the jnp kernels and the NumPy oracle,
+exercise the duplicate-block merge in the insert path, and measure FPR
+against the configured bound (SURVEY.md §4.2 items 1 and 4 applied to the
+blocked spec).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tpubloom import BlockedBloomFilter, CPUBlockedBloomFilter, FilterConfig
+from tpubloom.params import theoretical_fpr
+
+
+def _rand_keys(n, rng, length=16):
+    return [rng.bytes(length) for _ in range(n)]
+
+
+@pytest.fixture
+def config():
+    return FilterConfig(m=1 << 20, k=7, key_len=16, block_bits=512)
+
+
+def test_roundtrip_and_negative(config):
+    rng = np.random.default_rng(1)
+    f = BlockedBloomFilter(config)
+    keys = _rand_keys(500, rng)
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    absent = _rand_keys(500, rng)
+    # at this fill the FPR is tiny; allow a stray hit or two
+    assert f.include_batch(absent).mean() < 0.05
+
+
+def test_parity_with_cpu_oracle(config):
+    rng = np.random.default_rng(2)
+    f = BlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config)
+    keys = _rand_keys(2000, rng)
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    # identical arrays bit for bit
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    probe = keys[:100] + _rand_keys(400, rng)
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.lists(st.binary(min_size=0, max_size=16), min_size=1, max_size=40),
+    st.lists(st.binary(min_size=0, max_size=16), min_size=1, max_size=40),
+)
+def test_parity_hypothesis(inserted, probes):
+    config = FilterConfig(m=1 << 14, k=5, key_len=16, block_bits=256)
+    f = BlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config)
+    f.insert_batch(inserted)
+    o.insert_batch(inserted)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    np.testing.assert_array_equal(
+        f.include_batch(probes), o.include_batch(probes)
+    )
+
+
+def test_duplicate_blocks_in_batch_merge():
+    """Many keys landing in the same block within one batch must ALL set
+    their bits (the segmented row-OR dedup path)."""
+    config = FilterConfig(m=1 << 10, k=4, key_len=16, block_bits=256)
+    # m=1024, block_bits=256 -> only 4 blocks: heavy duplication guaranteed
+    rng = np.random.default_rng(3)
+    keys = _rand_keys(300, rng)
+    f = BlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config)
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    assert f.include_batch(keys).all()
+
+
+def test_duplicate_keys_in_batch():
+    config = FilterConfig(m=1 << 14, k=5, block_bits=512)
+    f = BlockedBloomFilter(config)
+    f.insert_batch([b"same-key"] * 17 + [b"other"])
+    assert f.include(b"same-key")
+    assert f.include(b"other")
+
+
+def test_padding_rows_set_no_bits(config):
+    f = BlockedBloomFilter(config)
+    f.insert_batch([b"a"])  # bucket-padded to 64 internally
+    o = CPUBlockedBloomFilter(config)
+    o.insert_batch([b"a"])
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+
+
+def test_fpr_within_bound():
+    """Empirical FPR at design load stays within ~2x of the flat-filter
+    theory (blocked adds a small Poisson-skew excess; at 50% design load it
+    must remain well under the configured bound's ballpark)."""
+    config = FilterConfig(m=1 << 16, k=7, block_bits=512)
+    n = 4000  # ~ m ln2 / k would be capacity; this is ~60% of that
+    rng = np.random.default_rng(4)
+    f = BlockedBloomFilter(config)
+    f.insert_batch(_rand_keys(n, rng))
+    probes = _rand_keys(20000, rng)
+    fpr = f.include_batch(probes).mean()
+    flat_theory = theoretical_fpr(config.m, config.k, n)
+    assert fpr < max(4 * flat_theory, 1e-3), (fpr, flat_theory)
+
+
+def test_serialization_roundtrip(config):
+    rng = np.random.default_rng(5)
+    keys = _rand_keys(1000, rng)
+    f = BlockedBloomFilter(config)
+    f.insert_batch(keys)
+    data = f.to_bytes()
+    g = BlockedBloomFilter.from_bytes(config, data)
+    assert g.include_batch(keys).all()
+    o = CPUBlockedBloomFilter.from_bytes(config, data)
+    assert o.include_batch(keys).all()
+
+
+def test_clear(config):
+    f = BlockedBloomFilter(config)
+    f.insert_batch([b"x"])
+    f.clear()
+    assert not f.include(b"x")
+    assert f.fill_ratio() == 0.0
+
+
+def test_default_block_bits():
+    f = BlockedBloomFilter(FilterConfig(m=1 << 16, k=7))
+    assert f.config.block_bits == 512
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        FilterConfig(m=1 << 16, k=7, block_bits=300)
+    with pytest.raises(ValueError, match="counting"):
+        FilterConfig(m=1 << 16, k=7, block_bits=512, counting=True)
+    with pytest.raises(ValueError, match="power-of-two m"):
+        FilterConfig(m=96, k=7, block_bits=512)
+
+
+def test_checkpoint_roundtrip_blocked(tmp_path):
+    from tpubloom import checkpoint as ckpt
+
+    config = FilterConfig(
+        m=1 << 16, k=7, block_bits=512, key_name="blk", key_len=16
+    )
+    rng = np.random.default_rng(6)
+    keys = _rand_keys(1500, rng)
+    f = BlockedBloomFilter(config)
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    g = ckpt.restore(config, sink)
+    assert isinstance(g, BlockedBloomFilter)
+    assert g.include_batch(keys).all()
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    # restoring under the flat spec must be refused (different position spec)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="block_bits"):
+        ckpt.restore(config.replace(block_bits=0), sink)
+
+
+def test_server_creates_blocked_filter():
+    from tpubloom.server.service import BloomService
+
+    svc = BloomService()
+    resp = svc.CreateFilter(
+        {
+            "name": "blk",
+            "config": FilterConfig(m=1 << 16, k=7, block_bits=512).to_dict(),
+        }
+    )
+    assert resp["ok"]
+    svc.InsertBatch({"name": "blk", "keys": [b"alpha", b"beta"]})
+    hits = svc.QueryBatch({"name": "blk", "keys": [b"alpha", b"gamma"]})
+    assert hits["ok"]
+    bits = np.unpackbits(np.frombuffer(hits["hits"], np.uint8))[: hits["n"]]
+    assert bits[0] == 1
+    st = svc.Stats({"name": "blk"})["stats"]
+    assert st["block_bits"] == 512
+
+
+def test_identity_mismatch_treats_missing_block_bits_as_flat():
+    from tpubloom.config import identity_mismatch
+
+    a = FilterConfig(m=1 << 16, k=7)
+    legacy = {"m": 1 << 16, "k": 7, "seed": a.seed, "counting": False, "shards": 1}
+    assert identity_mismatch(a, legacy) is None
+    b = FilterConfig(m=1 << 16, k=7, block_bits=512)
+    assert identity_mismatch(b, legacy) == "block_bits"

@@ -20,8 +20,8 @@ was empty at survey time, so parity targets come from BASELINE.json):
 from tpubloom.version import __version__
 from tpubloom.params import optimal_m_k, theoretical_fpr
 from tpubloom.config import FilterConfig
-from tpubloom.filter import BloomFilter, CountingBloomFilter
-from tpubloom.cpu_ref import CPUBloomFilter
+from tpubloom.filter import BlockedBloomFilter, BloomFilter, CountingBloomFilter
+from tpubloom.cpu_ref import CPUBlockedBloomFilter, CPUBloomFilter
 from tpubloom.scalable import CPUScalableBloomFilter, ScalableBloomFilter
 
 __all__ = [
@@ -30,8 +30,10 @@ __all__ = [
     "theoretical_fpr",
     "FilterConfig",
     "BloomFilter",
+    "BlockedBloomFilter",
     "CountingBloomFilter",
     "CPUBloomFilter",
+    "CPUBlockedBloomFilter",
     "ScalableBloomFilter",
     "CPUScalableBloomFilter",
 ]

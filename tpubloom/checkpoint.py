@@ -58,6 +58,11 @@ def _serialize(
     if config.counting:
         payload = words.astype("<u4").tobytes()
         fmt = "counting_le_words"
+    elif config.block_bits:
+        # blocked layout is its own position spec — exporting it as a Redis
+        # bitmap would look like (wrong) flat positions; store raw rows.
+        payload = words.reshape(-1).astype("<u4").tobytes()
+        fmt = "blocked_le_words"
     else:
         payload = words_to_redis_bitmap(words.reshape(-1), config.m)
         fmt = "redis_bitmap"
@@ -85,7 +90,7 @@ def _deserialize(data: bytes) -> Tuple[dict, bytes]:
 def payload_to_words(config: FilterConfig, header: dict, payload: bytes) -> np.ndarray:
     from tpubloom.utils.packing import redis_bitmap_to_words
 
-    if header["format"] == "counting_le_words":
+    if header["format"] in ("counting_le_words", "blocked_le_words"):
         return np.frombuffer(payload, dtype="<u4").astype(np.uint32)
     return redis_bitmap_to_words(payload, config.m)
 
@@ -218,9 +223,12 @@ def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
     saved = header["config"]
     field = identity_mismatch(saved, config)
     if field is not None:
+        # .get: legacy headers may predate a field (it then mismatched
+        # against the field's default, e.g. block_bits -> flat)
         raise ValueError(
             f"checkpoint/config mismatch on {field}: "
-            f"saved={saved[field]} requested={getattr(config, field)}"
+            f"saved={saved.get(field, '<absent: default>')} "
+            f"requested={getattr(config, field)}"
         )
     words = payload_to_words(config, header, payload)
     if config.counting:
@@ -235,8 +243,19 @@ def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
         import jax
 
         f = ShardedBloomFilter(config)
-        f.words = jax.device_put(
-            words.reshape(config.shards, config.n_words_per_shard), f.sharding
+        shape = (
+            (config.shards, config.n_blocks_per_shard, config.words_per_block)
+            if config.block_bits
+            else (config.shards, config.n_words_per_shard)
+        )
+        f.words = jax.device_put(words.reshape(shape), f.sharding)
+    elif config.block_bits:
+        from tpubloom.filter import BlockedBloomFilter
+        import jax.numpy as jnp
+
+        f = BlockedBloomFilter(config)
+        f.words = jnp.asarray(
+            words.reshape(config.n_blocks, config.words_per_block)
         )
     else:
         from tpubloom.filter import BloomFilter

@@ -103,6 +103,91 @@ def positions_np(
     return (pos32 % np.uint32(m)).astype(np.uint64)
 
 
+def blocked_positions_np(
+    keys: np.ndarray,
+    lengths: np.ndarray,
+    *,
+    n_blocks: int,
+    block_bits: int,
+    k: int,
+    seed: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Blocked-spec coordinates (mirrors tpubloom.ops.blocked.block_positions):
+    returns ``(blk int64[B], bit uint32[B, k])``."""
+    h_a = murmur3_32_np(keys, lengths, seed)
+    g_a = fnv1a_32_np(keys, lengths)
+    g_b = murmur3_32_np(keys, lengths, seed ^ SEED_XOR_GB)
+    blk = (h_a & np.uint32(n_blocks - 1)).astype(np.int64)
+    stride = g_b | np.uint32(1)
+    i = np.arange(k, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        p = g_a[..., None] + i * stride[..., None]  # u32 wrap == mod 2^32
+    return blk, p & np.uint32(block_bits - 1)
+
+
+class CPUBlockedBloomFilter:
+    """NumPy oracle for the blocked layout (tpubloom.ops.blocked spec)."""
+
+    def __init__(self, config: FilterConfig):
+        if not config.block_bits:
+            config = config.replace(block_bits=512)
+        self.config = config
+        self.n_inserted = 0
+        self.words = np.zeros(
+            (config.n_blocks, config.words_per_block), dtype=np.uint32
+        )
+
+    def _coords(self, keys: Sequence[bytes | str]):
+        keys_u8, lengths = pack_keys(
+            keys, self.config.key_len, key_policy=self.config.key_policy
+        )
+        blk, bit = blocked_positions_np(
+            keys_u8, lengths,
+            n_blocks=self.config.n_blocks,
+            block_bits=self.config.block_bits,
+            k=self.config.k,
+            seed=self.config.seed,
+        )
+        word = (bit >> np.uint32(5)).astype(np.int64)
+        mask = np.uint32(1) << (bit & np.uint32(31))
+        return blk, word, mask
+
+    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
+        blk, word, mask = self._coords(keys)
+        k = self.config.k
+        np.bitwise_or.at(self.words, (np.repeat(blk, k), word.ravel()), mask.ravel())
+        self.n_inserted += len(keys)
+
+    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        blk, word, mask = self._coords(keys)
+        vals = self.words[blk[:, None], word]
+        return np.all((vals & mask) == mask, axis=-1)
+
+    def insert(self, key: bytes | str) -> None:
+        self.insert_batch([key])
+
+    def include(self, key: bytes | str) -> bool:
+        return bool(self.include_batch([key])[0])
+
+    def clear(self) -> None:
+        self.words[:] = 0
+        self.n_inserted = 0
+
+    def fill_ratio(self) -> float:
+        set_bits = int(np.unpackbits(self.words.view(np.uint8)).sum())
+        return set_bits / self.config.m
+
+    def to_bytes(self) -> bytes:
+        return self.words.astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(cls, config: FilterConfig, data: bytes) -> "CPUBlockedBloomFilter":
+        f = cls(config)
+        arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
+        f.words = arr.reshape(f.config.n_blocks, f.config.words_per_block)
+        return f
+
+
 class CPUBloomFilter:
     """NumPy bloom filter (plain or counting) with the framework's semantics.
 

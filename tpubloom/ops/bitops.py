@@ -46,6 +46,10 @@ def segmented_scan_last(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Inclusive segmented scan over runs of equal (sorted) keys.
 
+    ``vals`` may be 1-D ``[N]`` (flat scatter-OR path) or N-D ``[N, ...]``
+    (blocked layout: one mask row per key) — trailing dims are combined
+    elementwise within each run.
+
     Returns ``(scanned_vals, is_last)`` where ``scanned_vals[i]`` combines all
     ``vals[j]`` with ``j <= i`` in i's run, and ``is_last[i]`` marks the final
     element of each run (which therefore holds the full-run reduction).
@@ -57,8 +61,12 @@ def segmented_scan_last(
     shift = 1
     while shift < n:
         prev_keys = jnp.concatenate([jnp.full((shift,), -1, keys.dtype), keys[:-shift]])
-        prev_vals = jnp.concatenate([jnp.zeros((shift,), vals.dtype), vals[:-shift]])
-        vals = jnp.where(prev_keys == keys, op(vals, prev_vals), vals)
+        prev_vals = jnp.concatenate(
+            [jnp.zeros((shift,) + vals.shape[1:], vals.dtype), vals[:-shift]]
+        )
+        same = prev_keys == keys
+        same = same.reshape(same.shape + (1,) * (vals.ndim - 1))
+        vals = jnp.where(same, op(vals, prev_vals), vals)
         shift *= 2
     is_last = jnp.concatenate([keys[:-1] != keys[1:], jnp.ones((1,), bool)])
     return vals, is_last

@@ -1,0 +1,131 @@
+"""Blocked (cache-line) bloom-filter kernels — the throughput layout.
+
+Why this exists: the flat spec (tpubloom.ops.hashing) scatters each key's
+k bits uniformly over the whole m-bit array — k random 4-byte HBM
+accesses per key. TPU HBM serves random traffic at sector granularity
+(~512 B), so the flat hot path is latency-bound at roughly
+``k × (random access rate)``. The blocked layout (Putze, Sanders &
+Singler 2007, "Cache-, Hash- and Space-Efficient Bloom Filters")
+confines all k bits of a key to ONE ``block_bits``-sized block:
+
+* one contiguous 64–512 B row gather per query (vs k scattered reads),
+* one row read-modify-write per insert (vs k scattered RMWs),
+
+i.e. ~k× less random HBM traffic, which measured ~10× faster end-to-end
+on v5e at m=2^32, k=7. The price is a slightly higher FPR at high fill
+(block loads are Poisson-skewed); at the north-star operating point
+(fill ≈ 6%) the excess is negligible. See BloomFilter docstrings for the
+user-facing guidance.
+
+THE BLOCKED POSITION SPEC (canonical; CPU oracle + tests mirror it)
+-------------------------------------------------------------------
+Given the four base hashes of the flat spec (h_a, h_b, g_a, g_b — see
+tpubloom.ops.hashing), ``n_blocks = m / block_bits`` (both powers of 2):
+
+  blk     = h_a mod n_blocks                      # owning block
+  p_i     = (g_a + i·(g_b | 1)) mod 2^32,  i = 0..k-1
+  bit_i   = p_i mod block_bits                    # position inside block
+
+Bit ``bit_i`` of a block is bit ``bit_i mod 32`` (LSB-first) of word
+``bit_i div 32`` in the block's ``uint32[block_bits/32]`` row. Blocked
+arrays are therefore NOT bit-compatible with flat arrays; the layout is
+part of the filter's identity (config.block_bits).
+
+In-block positions may collide (the p_i stride walk can revisit a bit) —
+standard for blocked filters; the FPR tests measure the compound effect.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpubloom.ops import hashing
+from tpubloom.ops.bitops import segmented_scan_last
+
+
+def _u32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.uint32)
+
+
+def block_positions(
+    keys: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    n_blocks: int,
+    block_bits: int,
+    k: int,
+    seed: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked-spec coordinates of each key.
+
+    Returns ``(blk, bit)``: ``blk`` int32[...], owning block per key;
+    ``bit`` uint32[..., k], in-block bit positions.
+    """
+    h_a = hashing.murmur3_32(keys, lengths, seed)
+    g_a = hashing.fnv1a_32(keys, lengths)
+    g_b = hashing.murmur3_32(keys, lengths, seed ^ hashing.SEED_XOR_GB)
+    blk = (h_a & _u32(n_blocks - 1)).astype(jnp.int32)
+    stride = g_b | _u32(1)
+    mask = _u32(block_bits - 1)
+    bits = []
+    p = g_a
+    for i in range(k):
+        if i > 0:
+            p = p + stride  # u32 wrap == mod 2^32
+        bits.append(p & mask)
+    return blk, jnp.stack(bits, axis=-1)
+
+
+def build_masks(bit: jnp.ndarray, words_per_block: int) -> jnp.ndarray:
+    """OR the k in-block positions into per-key row masks.
+
+    ``bit``: uint32[B, k] in-block positions -> uint32[B, W] row masks,
+    W = words_per_block. Dense VPU work: B×k×W compares, no gathers.
+    """
+    word = (bit >> _u32(5)).astype(jnp.int32)  # [B, k] in [0, W)
+    one = _u32(1) << (bit & _u32(31))  # [B, k]
+    iota = lax.broadcasted_iota(jnp.int32, (1, words_per_block), 1)  # [1, W]
+    k = bit.shape[-1]
+    mask = jnp.zeros(bit.shape[:-1] + (words_per_block,), jnp.uint32)
+    for i in range(k):  # k is static and small; OR-accumulate one-hot words
+        mask = mask | jnp.where(
+            word[..., i, None] == iota, one[..., i, None], _u32(0)
+        )
+    return mask  # [B, W]
+
+
+def blocked_insert(
+    blocks: jnp.ndarray, blk: jnp.ndarray, masks: jnp.ndarray, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """OR each key's mask row into its block. Duplicate blocks within the
+    batch are merged by a sort + segmented row-OR so the final row scatter
+    has unique indices (same recipe as bitops.scatter_or, at row granularity
+    — 1 sort of B elements instead of B·k).
+
+    ``valid == False`` entries (batch padding) are redirected out of bounds
+    and dropped by the scatter.
+    """
+    n_blocks = blocks.shape[0]
+    b = jnp.where(valid, blk, n_blocks).astype(jnp.int32)
+    order = jnp.argsort(b)
+    bs = b[order]
+    rows, is_last = segmented_scan_last(bs, masks[order], jnp.bitwise_or)
+    target = jnp.where(is_last & (bs < n_blocks), bs, n_blocks)
+    current = blocks[jnp.minimum(bs, n_blocks - 1)]
+    merged = current | rows
+    return blocks.at[target].set(merged, mode="drop", unique_indices=True)
+
+
+def blocked_query(
+    blocks: jnp.ndarray, blk: jnp.ndarray, masks: jnp.ndarray
+) -> jnp.ndarray:
+    """Membership: one row gather per key + all-mask-bits-present test.
+
+    Padded entries carry the empty-key verdict (length is clamped to 0
+    upstream, so their masks are the hash of ``b""``, not zeros) — callers
+    must trim the batch (include_batch) or mask the result (sharded
+    ``owned``); the values at padded positions are meaningless.
+    """
+    rows = blocks[blk]
+    return jnp.all((rows & masks) == masks, axis=-1)

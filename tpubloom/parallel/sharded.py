@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpubloom.config import FilterConfig
 from tpubloom.filter import _FilterBase
-from tpubloom.ops import bitops, hashing
+from tpubloom.ops import bitops, blocked, hashing
 from tpubloom.utils.packing import redis_bitmap_to_words, words_to_redis_bitmap
 
 AXIS = "shards"
@@ -140,6 +140,70 @@ def make_sharded_query_fn(config: FilterConfig, mesh: Mesh):
     )
 
 
+def _routed_blocks(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
+    """Blocked-layout preamble: route keys to shards, then to this device's
+    local block rows. Returns ``(blk[B], masks[B, W], owned[B])`` with
+    ``blk`` indexing the device-local ``[shards_per_dev * n_blocks_local]``
+    row space (clamped to 0 for unowned keys)."""
+    nbl = config.n_blocks_per_shard
+    dev = jax.lax.axis_index(AXIS)
+    lens = jnp.maximum(lengths, 0)
+    route = hashing.route_shards(
+        keys_u8, lens, n_shards=config.shards, seed=config.seed
+    ).astype(jnp.int32)
+    blk, bit = blocked.block_positions(
+        keys_u8, lens,
+        n_blocks=nbl, block_bits=config.block_bits, k=config.k,
+        seed=config.seed,
+    )
+    masks = blocked.build_masks(bit, config.words_per_block)
+    local_row = route - dev * shards_per_dev
+    owned = (local_row >= 0) & (local_row < shards_per_dev) & (lengths >= 0)
+    blk = blk + jnp.where(owned, local_row, 0) * nbl
+    return blk, masks, owned
+
+
+def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
+    """Blocked-layout sharded insert: ``(blocks[S, NBL, W], keys, lengths)``
+    with ``blocks`` sharded over ``shards``; one row RMW per owned key."""
+    shards_per_dev = config.shards // mesh.devices.size
+
+    def local_insert(blocks_block, keys_u8, lengths):
+        # blocks_block: [shards_per_dev, n_blocks_local, W] — local rows.
+        blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
+        flat = blocks_block.reshape(-1, config.words_per_block)
+        flat = blocked.blocked_insert(flat, blk, masks, owned)
+        return flat.reshape(blocks_block.shape)
+
+    return shard_map(
+        local_insert,
+        mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(), P()),
+        out_specs=P(AXIS, None, None),
+    )
+
+
+def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
+    """Blocked-layout sharded membership with the same psum-OR assembly as
+    the flat path: owners answer, ICI all-reduce merges."""
+    shards_per_dev = config.shards // mesh.devices.size
+
+    def local_query(blocks_block, keys_u8, lengths):
+        blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
+        flat = blocks_block.reshape(-1, config.words_per_block)
+        verdict = blocked.blocked_query(flat, blk, masks)
+        one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
+        hit = jax.lax.psum(one_hot, AXIS)
+        return hit > 0
+
+    return shard_map(
+        local_query,
+        mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(), P()),
+        out_specs=P(),
+    )
+
+
 class ShardedBloomFilter(_FilterBase):
     """Filter array over a device mesh (config 5). API-compatible with
     :class:`tpubloom.filter.BloomFilter`."""
@@ -161,54 +225,37 @@ class ShardedBloomFilter(_FilterBase):
                 f"{self.mesh.devices.size}"
             )
         super().__init__(config, 0)  # words set below with explicit sharding
-        self.sharding = NamedSharding(self.mesh, P(AXIS, None))
-        self.words = jax.device_put(
-            jnp.zeros((config.shards, config.n_words_per_shard), jnp.uint32),
-            self.sharding,
-        )
-        self._insert = jax.jit(
-            make_sharded_insert_fn(config, self.mesh), donate_argnums=0
-        )
-        self._query = jax.jit(make_sharded_query_fn(config, self.mesh))
-
-    def insert_batch(self, keys: Sequence[bytes | str]) -> None:
-        keys_u8, lengths, B = self._pack_padded(keys)
-        self.words = self._insert(self.words, keys_u8, lengths)
-        self.n_inserted += B
-
-    def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
-        keys_u8, lengths, B = self._pack_padded(keys)
-        out = np.asarray(self._query(self.words, keys_u8, lengths))
-        self.n_queried += B
-        return out[:B]
-
-    def insert_arrays(self, keys_u8, lengths, *, n_valid: int | None = None) -> None:
-        """``n_valid`` = true key count when the batch carries static-shape
-        padding (see BloomFilter.insert_arrays)."""
-        self.words = self._insert(self.words, keys_u8, lengths)
-        self.n_inserted += int(keys_u8.shape[0]) if n_valid is None else n_valid
-
-    def include_arrays(self, keys_u8, lengths):
-        self.n_queried += int(keys_u8.shape[0])
-        return self._query(self.words, keys_u8, lengths)
-
-    def insert(self, key: bytes | str) -> None:
-        self.insert_batch([key])
-
-    def include(self, key: bytes | str) -> bool:
-        return bool(self.include_batch([key])[0])
-
-    __contains__ = include
+        if config.block_bits:
+            self.sharding = NamedSharding(self.mesh, P(AXIS, None, None))
+            self.words = jax.device_put(
+                jnp.zeros(
+                    (
+                        config.shards,
+                        config.n_blocks_per_shard,
+                        config.words_per_block,
+                    ),
+                    jnp.uint32,
+                ),
+                self.sharding,
+            )
+            self._insert = jax.jit(
+                make_sharded_blocked_insert_fn(config, self.mesh), donate_argnums=0
+            )
+            self._query = jax.jit(make_sharded_blocked_query_fn(config, self.mesh))
+        else:
+            self.sharding = NamedSharding(self.mesh, P(AXIS, None))
+            self.words = jax.device_put(
+                jnp.zeros((config.shards, config.n_words_per_shard), jnp.uint32),
+                self.sharding,
+            )
+            self._insert = jax.jit(
+                make_sharded_insert_fn(config, self.mesh), donate_argnums=0
+            )
+            self._query = jax.jit(make_sharded_query_fn(config, self.mesh))
 
     def clear(self) -> None:
         self.words = jax.device_put(jnp.zeros_like(self.words), self.sharding)
         self.n_inserted = 0
-
-    def fill_ratio(self) -> float:
-        return float(bitops.popcount_fill(self.words, self.config.m))
-
-    def estimated_fpr(self) -> float:
-        return self.fill_ratio() ** self.config.k
 
     def stats(self) -> dict:
         return {
@@ -227,6 +274,11 @@ class ShardedBloomFilter(_FilterBase):
     # through the same Redis-bitmap format as the single-device filter.
 
     def to_redis_bitmap(self) -> bytes:
+        if self.config.block_bits:
+            raise ValueError(
+                "blocked layout is not Redis-bitmap exportable (different "
+                "position spec); use to_bytes"
+            )
         host = np.asarray(self.words).reshape(-1)
         return words_to_redis_bitmap(host, self.config.m)
 
@@ -234,9 +286,27 @@ class ShardedBloomFilter(_FilterBase):
     def from_redis_bitmap(
         cls, config: FilterConfig, data: bytes, **kwargs
     ) -> "ShardedBloomFilter":
+        if config.block_bits:
+            raise ValueError("blocked layout restores via from_bytes")
         f = cls(config, **kwargs)
         words = redis_bitmap_to_words(data, config.m).reshape(
             config.shards, config.n_words_per_shard
         )
         f.words = jax.device_put(jnp.asarray(words), f.sharding)
+        return f
+
+    # blocked-layout persistence: raw LE words, shard-major then row-major
+
+    def to_bytes(self) -> bytes:
+        return np.asarray(self.words).reshape(-1).astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(
+        cls, config: FilterConfig, data: bytes, **kwargs
+    ) -> "ShardedBloomFilter":
+        f = cls(config, **kwargs)
+        arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
+        f.words = jax.device_put(
+            jnp.asarray(arr.reshape(f.words.shape)), f.sharding
+        )
         return f

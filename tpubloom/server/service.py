@@ -138,9 +138,14 @@ class BloomService:
             elif config.counting:
                 filt = CountingBloomFilter(config)
             elif config.shards > 1:
+                # handles both flat and blocked layouts
                 from tpubloom.parallel.sharded import ShardedBloomFilter
 
                 filt = ShardedBloomFilter(config)
+            elif config.block_bits:
+                from tpubloom.filter import BlockedBloomFilter
+
+                filt = BlockedBloomFilter(config)
             else:
                 filt = BloomFilter(config)
             self._filters[name] = _Managed(

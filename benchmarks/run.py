@@ -66,19 +66,23 @@ def config1(scale: float) -> dict:
     }
 
 
-def config2(scale: float) -> dict:
+def config2(scale: float, layout: str = "flat") -> dict:
     """URL-dedup: batched inserts then mixed-hit queries on one device."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from tpubloom import BloomFilter, FilterConfig
+    from tpubloom import BlockedBloomFilter, BloomFilter, FilterConfig
 
     n = int(100_000_000 * scale)
     nq = int(10_000_000 * scale)
     log2m = 30 if scale >= 0.1 else 24
-    cfg = FilterConfig(m=1 << log2m, k=10, key_len=16)
-    f = BloomFilter(cfg)
+    if layout == "blocked":
+        cfg = FilterConfig(m=1 << log2m, k=10, key_len=16, block_bits=512)
+        f = BlockedBloomFilter(cfg)
+    else:
+        cfg = FilterConfig(m=1 << log2m, k=10, key_len=16)
+        f = BloomFilter(cfg)
     B = min(1 << 20, max(1 << 12, n // 8))
     t0 = time.perf_counter()
     done = 0
@@ -109,6 +113,7 @@ def config2(scale: float) -> dict:
     t_query = time.perf_counter() - t0
     return {
         "config": 2,
+        "layout": layout,
         "m": cfg.m,
         "n_insert": n,
         "n_query": qdone,
@@ -175,7 +180,7 @@ def config4(scale: float) -> dict:
     }
 
 
-def config5(scale: float) -> dict:
+def config5(scale: float, layout: str = "flat") -> dict:
     """64-shard filter array over the available mesh."""
     import jax
     import numpy as np
@@ -186,7 +191,10 @@ def config5(scale: float) -> dict:
     n = int(10_000_000 * scale)
     n_dev = len(jax.devices())
     log2m = 36 if scale >= 0.1 and n_dev >= 8 else 24
-    cfg = FilterConfig(m=1 << log2m, k=7, key_len=16, shards=64)
+    cfg = FilterConfig(
+        m=1 << log2m, k=7, key_len=16, shards=64,
+        block_bits=512 if layout == "blocked" else 0,
+    )
     f = ShardedBloomFilter(cfg)
     keys_u8, lengths = _gen_keys(min(n, 1 << 18))
     t0 = time.perf_counter()
@@ -202,6 +210,7 @@ def config5(scale: float) -> dict:
     assert hits.all()
     return {
         "config": 5,
+        "layout": layout,
         "m": cfg.m,
         "shards": 64,
         "devices": n_dev,
@@ -218,6 +227,10 @@ def main() -> None:
     ap.add_argument("--config", type=int, required=True, choices=sorted(CONFIGS))
     ap.add_argument("--scale", type=float, default=None)
     ap.add_argument("--platform", choices=["cpu", "tpu"], default=None)
+    ap.add_argument(
+        "--layout", choices=["flat", "blocked"], default="flat",
+        help="filter layout for device configs 2 and 5",
+    )
     args = ap.parse_args()
 
     import jax
@@ -229,7 +242,10 @@ def main() -> None:
     on_tpu = jax.default_backend() not in ("cpu",)
     scale = args.scale if args.scale is not None else (1.0 if on_tpu else 0.001)
 
-    result = CONFIGS[args.config](scale)
+    if args.config in (2, 5):
+        result = CONFIGS[args.config](scale, layout=args.layout)
+    else:
+        result = CONFIGS[args.config](scale)
     result["scale"] = scale
     result["platform"] = jax.default_backend()
     print(json.dumps(result))

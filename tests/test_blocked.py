@@ -145,6 +145,23 @@ def test_config_validation():
         FilterConfig(m=96, k=7, block_bits=512)
 
 
+def test_native_blocked_parity(config):
+    """C++ fused blocked path == NumPy path, bit for bit (when built)."""
+    from tpubloom import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    keys = _rand_keys(1500, rng) + [b"", b"x", b"abcdef"]
+    a = CPUBlockedBloomFilter(config, use_native=True)
+    b = CPUBlockedBloomFilter(config, use_native=False)
+    a.insert_batch(keys)
+    b.insert_batch(keys)
+    np.testing.assert_array_equal(a.words, b.words)
+    probes = keys[:200] + _rand_keys(300, rng)
+    np.testing.assert_array_equal(a.include_batch(probes), b.include_batch(probes))
+
+
 def test_checkpoint_roundtrip_blocked(tmp_path):
     from tpubloom import checkpoint as ckpt
 

@@ -17,17 +17,23 @@ def _rand_keys(n, rng, nbytes=16):
 
 
 class ShardedCPURef:
-    """Oracle: n independent CPU filters + the routing hash."""
+    """Oracle: n independent pure-NumPy CPU filters + the routing hash
+    (use_native pinned False — the ground truth must not be the C++ path).
+    Handles both layouts via the per-shard filter class."""
 
     def __init__(self, config):
         self.config = config
         local = FilterConfig(
             m=config.m_per_shard, k=config.k, seed=config.seed,
-            key_len=config.key_len,
+            key_len=config.key_len, block_bits=config.block_bits,
         )
-        self.filters = [
-            CPUBloomFilter(local, use_native=False) for _ in range(config.shards)
-        ]
+        if config.block_bits:
+            from tpubloom.cpu_ref import CPUBlockedBloomFilter
+
+            make = lambda: CPUBlockedBloomFilter(local, use_native=False)
+        else:
+            make = lambda: CPUBloomFilter(local, use_native=False)
+        self.filters = [make() for _ in range(config.shards)]
 
     def _route(self, keys):
         ks, ls = pack_keys(keys, self.config.key_len)
@@ -153,35 +159,6 @@ def test_graft_entry_multichip():
 # -- blocked layout over the mesh (throughput layout x config 5) -------------
 
 
-class ShardedBlockedCPURef:
-    """Oracle: n independent CPU blocked filters + the routing hash."""
-
-    def __init__(self, config):
-        from tpubloom.cpu_ref import CPUBlockedBloomFilter
-
-        self.config = config
-        local = FilterConfig(
-            m=config.m_per_shard, k=config.k, seed=config.seed,
-            key_len=config.key_len, block_bits=config.block_bits,
-        )
-        self.filters = [CPUBlockedBloomFilter(local) for _ in range(config.shards)]
-
-    def _route(self, keys):
-        ks, ls = pack_keys(keys, self.config.key_len)
-        return murmur3_32_np(ks, ls, self.config.seed ^ SEED_XOR_ROUTE) % np.uint32(
-            self.config.shards
-        )
-
-    def insert_batch(self, keys):
-        for key, r in zip(keys, self._route(keys)):
-            self.filters[r].insert(key)
-
-    def include_batch(self, keys):
-        return np.array(
-            [self.filters[r].include(key) for key, r in zip(keys, self._route(keys))]
-        )
-
-
 @pytest.fixture(scope="module")
 def blk_cfg8():
     assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
@@ -204,7 +181,7 @@ def test_blocked_parity_vs_oracle(blk_cfg8):
     rng = np.random.default_rng(11)
     keys = _rand_keys(500, rng) + [b"", b"a", b"sharded-key"]
     f = ShardedBloomFilter(blk_cfg8)
-    o = ShardedBlockedCPURef(blk_cfg8)
+    o = ShardedCPURef(blk_cfg8)
     f.insert_batch(keys)
     o.insert_batch(keys)
     dev = np.asarray(f.words)  # [shards, n_blocks_local, W]

@@ -126,13 +126,21 @@ def blocked_positions_np(
 
 
 class CPUBlockedBloomFilter:
-    """NumPy oracle for the blocked layout (tpubloom.ops.blocked spec)."""
+    """NumPy oracle for the blocked layout (tpubloom.ops.blocked spec).
 
-    def __init__(self, config: FilterConfig):
+    Like CPUBloomFilter, optionally dispatches the fused hot loop to the
+    C++ native library; ``use_native=False`` pins pure NumPy (parity tests
+    compare the two bit for bit).
+    """
+
+    def __init__(self, config: FilterConfig, *, use_native: bool | None = None):
         if not config.block_bits:
             config = config.replace(block_bits=512)
         self.config = config
         self.n_inserted = 0
+        if use_native is None:
+            use_native = native.available()
+        self.use_native = use_native
         self.words = np.zeros(
             (config.n_blocks, config.words_per_block), dtype=np.uint32
         )
@@ -153,12 +161,35 @@ class CPUBlockedBloomFilter:
         return blk, word, mask
 
     def insert_batch(self, keys: Sequence[bytes | str]) -> None:
-        blk, word, mask = self._coords(keys)
-        k = self.config.k
-        np.bitwise_or.at(self.words, (np.repeat(blk, k), word.ravel()), mask.ravel())
+        if self.use_native:
+            keys_u8, lengths = pack_keys(
+                keys, self.config.key_len, key_policy=self.config.key_policy
+            )
+            native.blocked_insert(
+                self.words, keys_u8, lengths,
+                n_blocks=self.config.n_blocks,
+                block_bits=self.config.block_bits,
+                k=self.config.k, seed=self.config.seed,
+            )
+        else:
+            blk, word, mask = self._coords(keys)
+            k = self.config.k
+            np.bitwise_or.at(
+                self.words, (np.repeat(blk, k), word.ravel()), mask.ravel()
+            )
         self.n_inserted += len(keys)
 
     def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
+        if self.use_native:
+            keys_u8, lengths = pack_keys(
+                keys, self.config.key_len, key_policy=self.config.key_policy
+            )
+            return native.blocked_query(
+                self.words, keys_u8, lengths,
+                n_blocks=self.config.n_blocks,
+                block_bits=self.config.block_bits,
+                k=self.config.k, seed=self.config.seed,
+            ).astype(bool)
         blk, word, mask = self._coords(keys)
         vals = self.words[blk[:, None], word]
         return np.all((vals & mask) == mask, axis=-1)

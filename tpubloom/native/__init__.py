@@ -59,6 +59,8 @@ def _load():
         lib.bh_query.argtypes = [u32p, u64p, ctypes.c_int64, ctypes.c_int32, u8p]
         lib.bh_hash_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32]
         lib.bh_hash_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32, u8p]
+        lib.bh_blocked_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32]
+        lib.bh_blocked_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, u8p]
         _lib = lib
         HAS_NATIVE = True
         return lib
@@ -125,6 +127,35 @@ def hash_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int
         _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(m), k,
         ctypes.c_uint32(seed),
     )
+
+
+def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int) -> None:
+    """Fused blocked-spec insert into ``uint32[n_blocks, W]`` (in place)."""
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    lib.bh_blocked_insert(
+        _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
+        _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(n_blocks),
+        block_bits, k, ctypes.c_uint32(seed),
+    )
+
+
+def blocked_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B, L = keys.shape
+    out = np.empty(B, dtype=np.uint8)
+    lib.bh_blocked_query(
+        _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
+        _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(n_blocks),
+        block_bits, k, ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint8),
+    )
+    return out
 
 
 def hash_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int, k: int, seed: int) -> np.ndarray:

@@ -34,6 +34,11 @@ class Redis
 
         IDENTITY = proc { |bytes| bytes }
 
+        # Non-idempotent RPCs are never auto-retried: a counting-filter
+        # delete (or insert — counters are scatter-ADDs, not idempotent
+        # ORs) that DID land would be applied twice on replay.
+        NO_RETRY = %w[DeleteBatch].freeze
+
         # opts mirrors the reference constructor options plus:
         #   :address       - "host:port" of the tpubloom server (default
         #                    127.0.0.1:50051)
@@ -41,9 +46,16 @@ class Redis
         #   :error_rate    - desired false-positive probability
         #   :key_name      - filter name (also the Redis checkpoint key)
         #   :counting      - use the counting variant (enables #delete)
+        #   :max_retries   - UNAVAILABLE retry budget (default 5); retried
+        #                    ops are idempotent bloom ops, with exponential
+        #                    backoff + jitter. On NOT_FOUND after a server
+        #                    restart the driver transparently re-creates the
+        #                    filter (the server restores its newest
+        #                    checkpoint) and retries once.
         def initialize(opts = {})
           @opts = opts
           @name = opts[:key_name] || "tpubloom"
+          @max_retries = opts[:max_retries] || 5
           address = opts[:address] || "127.0.0.1:50051"
           @stub = GRPC::ClientStub.new(address, :this_channel_is_insecure)
           create_filter
@@ -102,7 +114,36 @@ class Redis
           rpc("CreateFilter", req)
         end
 
+        def counting?
+          !!(@opts[:counting] || (@opts[:config] || {})["counting"] ||
+             (@opts[:config] || {})[:counting])
+        end
+
         def rpc(method, payload)
+          no_retry = NO_RETRY.include?(method) ||
+                     (method == "InsertBatch" && counting?)
+          retries = no_retry ? 0 : @max_retries
+          attempt = 0
+          recreated = false
+          begin
+            rpc_once(method, payload)
+          rescue GRPC::Unavailable
+            raise if attempt >= retries
+            sleep([0.2 * (2**attempt), 5.0].min * (0.5 + rand))
+            attempt += 1
+            retry
+          rescue RuntimeError => e
+            # A restarted server has not seen the filter yet: re-create it
+            # (restores the newest checkpoint), then retry the op once.
+            raise unless e.message.include?("NOT_FOUND") &&
+                         method != "CreateFilter" && !recreated
+            recreated = true
+            create_filter
+            retry
+          end
+        end
+
+        def rpc_once(method, payload)
           raw = @stub.request_response(
             "/#{SERVICE}/#{method}",
             payload.to_msgpack,

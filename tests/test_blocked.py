@@ -38,7 +38,7 @@ def test_roundtrip_and_negative(config):
 def test_parity_with_cpu_oracle(config):
     rng = np.random.default_rng(2)
     f = BlockedBloomFilter(config)
-    o = CPUBlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     keys = _rand_keys(2000, rng)
     f.insert_batch(keys)
     o.insert_batch(keys)
@@ -56,7 +56,7 @@ def test_parity_with_cpu_oracle(config):
 def test_parity_hypothesis(inserted, probes):
     config = FilterConfig(m=1 << 14, k=5, key_len=16, block_bits=256)
     f = BlockedBloomFilter(config)
-    o = CPUBlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     f.insert_batch(inserted)
     o.insert_batch(inserted)
     np.testing.assert_array_equal(np.asarray(f.words), o.words)
@@ -73,7 +73,7 @@ def test_duplicate_blocks_in_batch_merge():
     rng = np.random.default_rng(3)
     keys = _rand_keys(300, rng)
     f = BlockedBloomFilter(config)
-    o = CPUBlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     f.insert_batch(keys)
     o.insert_batch(keys)
     np.testing.assert_array_equal(np.asarray(f.words), o.words)
@@ -91,7 +91,7 @@ def test_duplicate_keys_in_batch():
 def test_padding_rows_set_no_bits(config):
     f = BlockedBloomFilter(config)
     f.insert_batch([b"a"])  # bucket-padded to 64 internally
-    o = CPUBlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     o.insert_batch([b"a"])
     np.testing.assert_array_equal(np.asarray(f.words), o.words)
 

@@ -1,0 +1,128 @@
+"""Failure-detection / elastic-recovery tests (SURVEY.md §5 failure row:
+"gRPC health check + reconnect/backoff in the ... shim; server restart ->
+restore newest checkpoint; fault-injection test: kill server mid-stream").
+
+The "crash" is an abrupt grpc-server stop with the service state thrown
+away (what a SIGKILL does to the process's memory); the "restart" is a
+brand-new BloomService on the same port backed by the same checkpoint
+directory. The client must ride through both failure modes on its own:
+UNAVAILABLE while the port is dead (backoff+retry) and NOT_FOUND once the
+new server is up (replay create -> checkpoint restore -> retry).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpubloom import checkpoint as ckpt
+from tpubloom.server.client import BloomClient
+from tpubloom.server.protocol import BloomServiceError
+from tpubloom.server.service import BloomService, build_server
+
+
+def _rand_keys(n, rng):
+    return [rng.bytes(16) for _ in range(n)]
+
+
+def _start(tmp_path, port=0):
+    service = BloomService(sink_factory=lambda config: ckpt.FileSink(str(tmp_path)))
+    srv, bound = build_server(service, f"127.0.0.1:{port}")
+    srv.start()
+    return srv, service, bound
+
+
+def test_client_survives_server_crash_and_restart(tmp_path):
+    srv, service, port = _start(tmp_path)
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=8, backoff_base=0.1)
+    client.wait_ready()
+    restarted = []  # keep the new server referenced or grpc GCs it
+    try:
+        client.create_filter("crashy", capacity=50_000, error_rate=0.01)
+        rng = np.random.default_rng(7)
+        keys = _rand_keys(2000, rng)
+        client.insert_batch("crashy", keys)
+        client.checkpoint("crashy", wait=True)  # durability point
+
+        # crash: port goes dead, in-memory state (incl. the filter) is lost
+        srv.stop(grace=None)
+        del service
+
+        def restart():
+            time.sleep(0.6)
+            restarted.append(_start(tmp_path, port))
+
+        t = threading.Thread(target=restart, daemon=True)
+        t.start()
+
+        # issued while the port is DOWN: must backoff through UNAVAILABLE,
+        # then heal NOT_FOUND by replaying the creation (-> restore)
+        hits = client.include_batch("crashy", keys)
+        t.join()
+        assert hits.all(), "restored filter lost checkpointed keys"
+        assert client.include_batch("crashy", _rand_keys(2000, rng)).mean() < 0.01
+        # and writes keep working against the restored filter
+        client.insert_batch("crashy", [b"post-crash"])
+        assert client.include("crashy", b"post-crash")
+    finally:
+        client.close()
+        for s, _, _ in restarted:
+            s.stop(grace=None)
+
+
+def test_post_checkpoint_tail_is_lost_not_corrupted(tmp_path):
+    """Inserts after the last checkpoint are bounded tail loss — the
+    restored filter answers consistently for everything checkpointed."""
+    srv, service, port = _start(tmp_path)
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=8, backoff_base=0.1)
+    client.wait_ready()
+    srv2 = None
+    try:
+        client.create_filter("tail", capacity=50_000, error_rate=0.01)
+        rng = np.random.default_rng(8)
+        durable = _rand_keys(1000, rng)
+        client.insert_batch("tail", durable)
+        client.checkpoint("tail", wait=True)
+        tail = _rand_keys(1000, rng)
+        client.insert_batch("tail", tail)  # never checkpointed
+
+        srv.stop(grace=None)
+        del service
+        srv2 = _start(tmp_path, port)  # keep referenced
+
+        assert client.include_batch("tail", durable).all()
+        # tail keys may be gone (crash-consistent semantics) — but answers
+        # must be bloom-consistent: re-inserting them must make them present
+        client.insert_batch("tail", tail)
+        assert client.include_batch("tail", tail).all()
+    finally:
+        client.close()
+        if srv2 is not None:
+            srv2[0].stop(grace=None)
+
+
+def test_not_found_without_remembered_creation_still_raises(tmp_path):
+    srv, _, port = _start(tmp_path)
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=1)
+    client.wait_ready()
+    try:
+        with pytest.raises(BloomServiceError, match="NOT_FOUND"):
+            client.insert_batch("never-created", [b"x"])
+    finally:
+        client.close()
+        srv.stop(grace=None)
+
+
+def test_unavailable_exhausts_retries(tmp_path):
+    # nothing listens on this port; backoff must give up, not hang forever
+    import grpc
+
+    client = BloomClient("127.0.0.1:1", max_retries=2, backoff_base=0.05, timeout=2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError):
+            client.health()
+        assert time.monotonic() - t0 < 30
+    finally:
+        client.close()

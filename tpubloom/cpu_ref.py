@@ -145,31 +145,33 @@ class CPUBlockedBloomFilter:
             (config.n_blocks, config.words_per_block), dtype=np.uint32
         )
 
-    def _coords(self, keys: Sequence[bytes | str]):
-        keys_u8, lengths = pack_keys(
+    def _packed(self, keys: Sequence[bytes | str]):
+        return pack_keys(
             keys, self.config.key_len, key_policy=self.config.key_policy
         )
-        blk, bit = blocked_positions_np(
-            keys_u8, lengths,
+
+    def _spec_kwargs(self) -> dict:
+        # the one definition of the blocked-spec parameter set, shared by
+        # the native dispatch and the NumPy path
+        return dict(
             n_blocks=self.config.n_blocks,
             block_bits=self.config.block_bits,
             k=self.config.k,
             seed=self.config.seed,
         )
+
+    def _coords(self, keys: Sequence[bytes | str]):
+        keys_u8, lengths = self._packed(keys)
+        blk, bit = blocked_positions_np(keys_u8, lengths, **self._spec_kwargs())
         word = (bit >> np.uint32(5)).astype(np.int64)
         mask = np.uint32(1) << (bit & np.uint32(31))
         return blk, word, mask
 
     def insert_batch(self, keys: Sequence[bytes | str]) -> None:
         if self.use_native:
-            keys_u8, lengths = pack_keys(
-                keys, self.config.key_len, key_policy=self.config.key_policy
-            )
+            keys_u8, lengths = self._packed(keys)
             native.blocked_insert(
-                self.words, keys_u8, lengths,
-                n_blocks=self.config.n_blocks,
-                block_bits=self.config.block_bits,
-                k=self.config.k, seed=self.config.seed,
+                self.words, keys_u8, lengths, **self._spec_kwargs()
             )
         else:
             blk, word, mask = self._coords(keys)
@@ -181,14 +183,9 @@ class CPUBlockedBloomFilter:
 
     def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
         if self.use_native:
-            keys_u8, lengths = pack_keys(
-                keys, self.config.key_len, key_policy=self.config.key_policy
-            )
+            keys_u8, lengths = self._packed(keys)
             return native.blocked_query(
-                self.words, keys_u8, lengths,
-                n_blocks=self.config.n_blocks,
-                block_bits=self.config.block_bits,
-                k=self.config.k, seed=self.config.seed,
+                self.words, keys_u8, lengths, **self._spec_kwargs()
             ).astype(bool)
         blk, word, mask = self._coords(keys)
         vals = self.words[blk[:, None], word]

@@ -3,10 +3,27 @@
 Parity: the Python-native mirror of the Ruby ``:jax`` driver (SURVEY.md §1
 layer-map row L1: "Python-native API mirrors it") — same batch surface as
 the local :class:`tpubloom.filter.BloomFilter`, but over the wire.
+
+Failure handling (SURVEY.md §5 failure-detection row — "gRPC health check
++ reconnect/backoff"; the reference's redis-rb raises on connection loss
+and leaves retry to the caller, the new framework does better):
+
+* ``UNAVAILABLE`` (server down / restarting) is retried with exponential
+  backoff + jitter. Safe because every retried op is idempotent — bloom
+  insert/query/clear/checkpoint can be replayed freely. The one exception
+  is ``delete_batch``: a counting-filter delete that *did* land would be
+  applied twice on replay (double-decrement → false negatives), so it is
+  never auto-retried.
+* ``NOT_FOUND`` after a server restart (the new process has not seen the
+  filter yet) is healed transparently: the client replays the original
+  ``create_filter`` request with ``exist_ok=True, restore=True`` — the
+  server restores the newest checkpoint — then retries the op once.
 """
 
 from __future__ import annotations
 
+import random
+import time
 from typing import Optional, Sequence
 
 import grpc
@@ -14,13 +31,28 @@ import numpy as np
 
 from tpubloom.server import protocol
 
+# delete is always a counting-filter counter decrement — never idempotent
+_NO_RETRY = frozenset({"DeleteBatch"})
+
 
 class BloomClient:
     """Blocking client; one instance per channel, filters addressed by name."""
 
-    def __init__(self, address: str = "127.0.0.1:50051", *, timeout: float = 60.0):
+    def __init__(
+        self,
+        address: str = "127.0.0.1:50051",
+        *,
+        timeout: float = 60.0,
+        max_retries: int = 5,
+        backoff_base: float = 0.2,
+        backoff_max: float = 5.0,
+    ):
         self.address = address
         self.timeout = timeout
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._creations: dict[str, dict] = {}
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -37,9 +69,60 @@ class BloomClient:
             for m in protocol.METHODS
         }
 
-    def _rpc(self, method: str, req: dict) -> dict:
+    def _call_once(self, method: str, req: dict) -> dict:
         raw = self._calls[method](protocol.encode(req), timeout=self.timeout)
         return protocol.check(protocol.decode(raw))
+
+    def _is_counting(self, name: str) -> bool:
+        creation = self._creations.get(name, {})
+        return bool(
+            creation.get("config", {}).get("counting")
+            or creation.get("options", {}).get("counting")
+        )
+
+    def _rpc(self, method: str, req: dict) -> dict:
+        # Counting-filter inserts are scatter-ADDs, not idempotent OR —
+        # a replayed insert that DID land double-increments counters, so a
+        # later delete leaves residue (stuck false positives). Same reason
+        # DeleteBatch is never retried.
+        no_retry = method in _NO_RETRY or (
+            method == "InsertBatch" and self._is_counting(req.get("name", ""))
+        )
+        retries = 0 if no_retry else self.max_retries
+        recreated = False
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(method, req)
+            except grpc.RpcError as e:
+                if (
+                    e.code() is not grpc.StatusCode.UNAVAILABLE
+                    or attempt >= retries
+                ):
+                    raise
+                delay = min(
+                    self.backoff_max, self.backoff_base * (2 ** attempt)
+                ) * (0.5 + random.random())
+                time.sleep(delay)
+                attempt += 1
+            except protocol.BloomServiceError as e:
+                # Heal a restarted server: replay the remembered creation
+                # (restores the newest checkpoint), then retry the op once.
+                creation = self._creations.get(req.get("name", ""))
+                if (
+                    e.code != "NOT_FOUND"
+                    or method in ("CreateFilter", "DropFilter")
+                    or recreated
+                    or creation is None
+                ):
+                    raise
+                # through _rpc, not _call_once: the heal itself must ride
+                # out UNAVAILABLE if the server is still coming up
+                self._rpc(
+                    "CreateFilter",
+                    {**creation, "exist_ok": True, "restore": True},
+                )
+                recreated = True
 
     # -- service-level -------------------------------------------------------
 
@@ -68,12 +151,22 @@ class BloomClient:
             req["capacity"] = capacity
             req["error_rate"] = error_rate
             req["options"] = options
-        return self._rpc("CreateFilter", req)
+        resp = self._rpc("CreateFilter", req)
+        # Bare attaches (no config, no capacity) adopt the server's config —
+        # remember the adopted config so the NOT_FOUND heal can replay a
+        # well-formed creation.
+        if config is None and capacity is None:
+            self._creations[name] = {"name": name, "config": resp["config"]}
+        else:
+            self._creations[name] = req
+        return resp
 
     def drop_filter(self, name: str, *, final_checkpoint: bool = True) -> dict:
-        return self._rpc(
+        resp = self._rpc(
             "DropFilter", {"name": name, "final_checkpoint": final_checkpoint}
         )
+        self._creations.pop(name, None)  # only forget once the drop landed
+        return resp
 
     def list_filters(self) -> list:
         return self._rpc("ListFilters", {})["filters"]

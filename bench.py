@@ -128,6 +128,11 @@ def _run_bench(platform: str) -> dict:
     probe = jax.random.bits(jax.random.key(10_000_019), (B, key_len), jnp.uint8)
     fpr = float(np.asarray(query_jit(blk_state, probe, lengths)).mean())
 
+    from tpubloom.ops.sweep import auto_insert_path
+
+    insert_path = auto_insert_path(
+        jax.default_backend(), blk_config.n_blocks, B
+    )
     return {
         "metric": f"batched insert+query keys/sec/chip @ m=2^{log2m}, k=7",
         "value": round(blk_rate),
@@ -136,6 +141,7 @@ def _run_bench(platform: str) -> dict:
         "platform": jax.default_backend(),
         "device": str(jax.devices()[0]),
         "layout": "blocked512",
+        "insert_path": insert_path,
         "m": blk_config.m,
         "k": blk_config.k,
         "batch": B,

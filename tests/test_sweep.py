@@ -1,0 +1,117 @@
+"""Pallas partition-sweep insert (tpubloom.ops.sweep) vs the sorted-scatter
+blocked path and the CPU oracle.
+
+The sweep kernel is the TPU hot-loop replacement for XLA's serialized
+scatter (SURVEY.md §6/§7 "Pallas escape hatch"); here it runs in Pallas
+interpret mode on CPU, which executes the same kernel logic (DMAs,
+grid, chunk loop) without Mosaic. Bit-exactness against the scatter
+path on identical inputs is the contract: "auto" may pick either path
+per backend and the arrays must be interchangeable.
+
+Shapes are kept small (m = 2^22 -> 8192 blocks, P = 8..16 partitions)
+so interpret mode stays fast while still exercising multi-partition
+grids, DMA window alignment, padding keys, duplicate merging, and the
+overflow chunk loop.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tpubloom import CPUBlockedBloomFilter, FilterConfig
+from tpubloom.filter import make_blocked_insert_fn, make_blocked_query_fn
+from tpubloom.ops.sweep import choose_params, make_sweep_insert_fn, sweep_applicable
+from tpubloom.utils.packing import pack_keys
+
+import jax.numpy as jnp
+import jax
+
+
+@pytest.fixture
+def config():
+    return FilterConfig(m=1 << 22, k=7, key_len=16, block_bits=512)
+
+
+def _zeros(config):
+    return jnp.zeros((config.n_blocks, config.words_per_block), jnp.uint32)
+
+
+def _run_both(config, keys_u8, lengths):
+    scatter = jax.jit(
+        make_blocked_insert_fn(config.replace(insert_path="scatter"))
+    )
+    sweep = jax.jit(make_sweep_insert_fn(config, interpret=True))
+    a = np.asarray(scatter(_zeros(config), keys_u8, lengths))
+    b = np.asarray(sweep(_zeros(config), keys_u8, lengths))
+    return a, b
+
+
+def test_matches_scatter_random(config):
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 256, (512, 16), dtype=np.uint8))
+    lengths = jnp.full((512,), 16, jnp.int32)
+    a, b = _run_both(config, keys, lengths)
+    np.testing.assert_array_equal(a, b)
+    assert a.any()
+
+
+def test_padding_keys_set_no_bits(config):
+    rng = np.random.default_rng(1)
+    keys = jnp.asarray(rng.integers(0, 256, (256, 16), dtype=np.uint8))
+    lengths = jnp.asarray(
+        np.where(np.arange(256) % 3 == 0, -1, 16).astype(np.int32)
+    )
+    a, b = _run_both(config, keys, lengths)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_duplicate_heavy_overflow_chunks(config):
+    # every key identical: one partition holds the whole batch, forcing
+    # ceil(n / KMAX) > 1 serial chunks in-kernel
+    key = np.frombuffer(b"same-key-16bytes", dtype=np.uint8)
+    keys = jnp.asarray(np.tile(key, (1024, 1)))
+    lengths = jnp.full((1024,), 16, jnp.int32)
+    a, b = _run_both(config, keys, lengths)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_membership_roundtrip_against_oracle(config):
+    rng = np.random.default_rng(2)
+    raw = [rng.bytes(16) for _ in range(600)]
+    keys_u8, lengths = pack_keys(raw, config.key_len)
+    sweep = jax.jit(make_sweep_insert_fn(config, interpret=True))
+    blocks = sweep(_zeros(config), jnp.asarray(keys_u8), jnp.asarray(lengths))
+    oracle = CPUBlockedBloomFilter(config, use_native=False)
+    oracle.insert_batch(raw)
+    np.testing.assert_array_equal(np.asarray(blocks), oracle.words)
+    query = jax.jit(make_blocked_query_fn(config))
+    hits = query(blocks, jnp.asarray(keys_u8), jnp.asarray(lengths))
+    assert np.asarray(hits).all()
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.lists(st.binary(min_size=0, max_size=16), min_size=1, max_size=64))
+def test_hypothesis_parity(keys):
+    config = FilterConfig(m=1 << 22, k=5, key_len=16, block_bits=512)
+    keys_u8, lengths = pack_keys(keys, config.key_len)
+    a, b = _run_both(config, jnp.asarray(keys_u8), jnp.asarray(lengths))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_choose_params_and_applicability():
+    R, kmax = choose_params(1 << 23, 1 << 20)
+    assert (1 << 23) % R == 0
+    assert kmax % 8 == 0 and 16 <= kmax <= 1024
+    # per-partition occupancy fits the window with margin
+    lam = (1 << 20) // ((1 << 23) // R)
+    assert kmax > lam
+    assert sweep_applicable(1 << 23, 1 << 20)
+    # tiny filters stay on the scatter path
+    assert not sweep_applicable(64, 1 << 20)
+
+
+def test_insert_path_config_validation():
+    with pytest.raises(ValueError):
+        FilterConfig(m=1 << 20, k=7, insert_path="nope")
+    cfg = FilterConfig(m=1 << 22, k=7, block_bits=512, insert_path="scatter")
+    assert cfg.insert_path == "scatter"

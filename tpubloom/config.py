@@ -76,6 +76,11 @@ class FilterConfig:
         high fill for ~k× fewer random HBM accesses — the throughput layout.
         Positions follow the blocked spec in ``tpubloom.ops.blocked``;
         blocked filters are NOT bit-compatible with flat ones.
+      insert_path: blocked-insert implementation: ``"auto"`` (default)
+        picks the Pallas partition-sweep kernel on TPU when the shape
+        qualifies and the sorted-scatter XLA path otherwise; ``"sweep"``
+        / ``"scatter"`` force one. Not part of the filter's identity —
+        both paths produce bit-identical arrays.
     """
 
     m: int
@@ -88,6 +93,7 @@ class FilterConfig:
     key_name: str = "tpubloom"
     checkpoint_every: int = 0
     block_bits: int = 0
+    insert_path: str = "auto"
 
     def __post_init__(self) -> None:
         if self.m <= 0:
@@ -116,6 +122,10 @@ class FilterConfig:
             )
         if self.counting and self.m % 8 != 0:
             raise ValueError(f"counting filters need m divisible by 8, got {self.m}")
+        if self.insert_path not in ("auto", "sweep", "scatter"):
+            raise ValueError(
+                f"insert_path must be auto/sweep/scatter, got {self.insert_path}"
+            )
         if self.block_bits:
             bb = self.block_bits
             if bb & (bb - 1) or not (128 <= bb <= 4096):

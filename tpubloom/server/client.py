@@ -73,8 +73,17 @@ class BloomClient:
         raw = self._calls[method](protocol.encode(req), timeout=self.timeout)
         return protocol.check(protocol.decode(raw))
 
-    def _is_counting(self, name: str) -> bool:
-        creation = self._creations.get(name, {})
+    def _maybe_counting(self, name: str) -> bool:
+        """True unless the filter is KNOWN to be non-counting.
+
+        Filters not created through this client (e.g. attached by name
+        after another process made them) have unknown countingness —
+        treated as counting, i.e. their inserts are never auto-retried,
+        because a replayed counting insert that did land
+        double-increments."""
+        creation = self._creations.get(name)
+        if creation is None:
+            return True
         return bool(
             creation.get("config", {}).get("counting")
             or creation.get("options", {}).get("counting")
@@ -86,7 +95,7 @@ class BloomClient:
         # later delete leaves residue (stuck false positives). Same reason
         # DeleteBatch is never retried.
         no_retry = method in _NO_RETRY or (
-            method == "InsertBatch" and self._is_counting(req.get("name", ""))
+            method == "InsertBatch" and self._maybe_counting(req.get("name", ""))
         )
         retries = 0 if no_retry else self.max_retries
         recreated = False

@@ -42,6 +42,7 @@ have no u64 — via k-step iterative addition with carry, which is exactly
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 # MurmurHash3_x86_32 constants (public domain algorithm by Austin Appleby).
 _C1 = 0xCC9E2D51
@@ -89,13 +90,16 @@ def murmur3_32(keys: jnp.ndarray, lengths: jnp.ndarray, seed) -> jnp.ndarray:
     L = keys.shape[-1]
     if L % 4 != 0:
         raise ValueError(f"key buffer length must be a multiple of 4, got {L}")
-    kb = keys.astype(jnp.uint32)
     # Little-endian 32-bit blocks: block[i] = bytes[4i] | bytes[4i+1]<<8 | ...
-    blocks = (
-        kb[..., 0::4]
-        | (kb[..., 1::4] << _u32(8))
-        | (kb[..., 2::4] << _u32(16))
-        | (kb[..., 3::4] << _u32(24))
+    # — exactly what a little-endian bitcast of 4 consecutive bytes gives
+    # (XLA bitcast_convert_type is LE on every supported backend; the
+    # strided-shift formulation is equivalent but costs 4 strided u8
+    # relayouts per block on TPU). The astype is a no-op for the uint8
+    # arrays every internal caller passes; it keeps byte values in wider
+    # dtypes bit-exact rather than silently mis-bitcasting them.
+    blocks = lax.bitcast_convert_type(
+        keys.astype(jnp.uint8).reshape(keys.shape[:-1] + (L // 4, 4)),
+        jnp.uint32,
     )
     lengths = lengths.astype(jnp.int32)
     h = jnp.broadcast_to(_u32(seed), lengths.shape)
@@ -130,9 +134,19 @@ def fnv1a_32(keys: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
     lengths = lengths.astype(jnp.int32)
     h = jnp.broadcast_to(_u32(_FNV_OFFSET), lengths.shape)
     prime = _u32(_FNV_PRIME)
-    kb = keys.astype(jnp.uint32)
+    if L % 4 == 0:
+        # extract bytes from bitcast u32 words (4 lanes instead of 16
+        # strided u8 lanes — cheaper layout on TPU)
+        words = lax.bitcast_convert_type(
+            keys.astype(jnp.uint8).reshape(keys.shape[:-1] + (L // 4, 4)),
+            jnp.uint32,
+        )
+        byte = lambda j: (words[..., j >> 2] >> _u32(8 * (j & 3))) & _u32(0xFF)
+    else:
+        kb = keys.astype(jnp.uint32)
+        byte = lambda j: kb[..., j]
     for j in range(L):
-        h_next = (h ^ kb[..., j]) * prime
+        h_next = (h ^ byte(j)) * prime
         h = jnp.where(j < lengths, h_next, h)
     return h
 

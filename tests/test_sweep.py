@@ -98,6 +98,17 @@ def test_hypothesis_parity(keys):
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.parametrize("k", [1, 2, 8])  # k=2 once hit a packed/unpacked
+def test_parity_small_k(k):                # ambiguity in the sort payload
+    config = FilterConfig(m=1 << 22, k=k, key_len=16, block_bits=512)
+    rng = np.random.default_rng(k)
+    keys = jnp.asarray(rng.integers(0, 256, (256, 16), dtype=np.uint8))
+    lengths = jnp.full((256,), 16, jnp.int32)
+    a, b = _run_both(config, keys, lengths)
+    np.testing.assert_array_equal(a, b)
+    assert a.any()
+
+
 def test_choose_params_and_applicability():
     R, kmax = choose_params(1 << 23, 1 << 20)
     assert (1 << 23) % R == 0
@@ -108,6 +119,91 @@ def test_choose_params_and_applicability():
     assert sweep_applicable(1 << 23, 1 << 20)
     # tiny filters stay on the scatter path
     assert not sweep_applicable(64, 1 << 20)
+
+
+def _run_test_insert(config, keys_u8, lengths, blocks):
+    fn = jax.jit(make_sweep_insert_fn(config, interpret=True, with_presence=True))
+    nb2, present = fn(blocks, jnp.asarray(keys_u8), jnp.asarray(lengths))
+    return np.asarray(nb2), np.asarray(present)
+
+
+def test_test_insert_presence_and_bits(config):
+    rng = np.random.default_rng(5)
+    first = [rng.bytes(16) for _ in range(300)]
+    second = [rng.bytes(16) for _ in range(300)]
+    k1, l1 = pack_keys(first, config.key_len)
+    oracle = CPUBlockedBloomFilter(config, use_native=False)
+    oracle.insert_batch(first)
+    sweep = jax.jit(make_sweep_insert_fn(config, interpret=True))
+    blocks = sweep(_zeros(config), jnp.asarray(k1), jnp.asarray(l1))
+
+    # second batch = mix of already-present and fresh keys
+    mixed = first[:150] + second
+    k2, l2 = pack_keys(mixed, config.key_len)
+    nb2, present = _run_test_insert(config, k2, l2, blocks)
+    assert present[:150].all(), "pre-inserted keys must report present"
+    # fresh random keys: FPR at this fill is ~0
+    assert present[150:].sum() <= 2
+    # bits identical to a plain insert of the same batch
+    plain = np.asarray(
+        sweep(
+            jax.jit(make_sweep_insert_fn(config, interpret=True))(
+                _zeros(config), jnp.asarray(k1), jnp.asarray(l1)
+            ),
+            jnp.asarray(k2),
+            jnp.asarray(l2),
+        )
+    )
+    np.testing.assert_array_equal(nb2, plain)
+
+
+def test_test_insert_duplicates_report_prebatch_state(config):
+    rng = np.random.default_rng(6)
+    keys = [rng.bytes(16) for _ in range(64)]
+    batch = keys + keys  # every key twice in ONE batch
+    ku, lu = pack_keys(batch, config.key_len)
+    _, present = _run_test_insert(config, ku, lu, _zeros(config))
+    assert not present.any(), "duplicates see the PRE-batch (empty) state"
+
+
+def test_test_insert_padding_tail(config):
+    rng = np.random.default_rng(7)
+    keys = [rng.bytes(16) for _ in range(100)]
+    ku, lu = pack_keys(keys, config.key_len)
+    ku = np.pad(ku, ((0, 28), (0, 0)))
+    lu = np.pad(lu, (0, 28), constant_values=-1)
+    _, present = _run_test_insert(config, ku, lu, _zeros(config))
+    assert not present.any()
+    assert present.shape == (128,)
+
+
+def test_test_insert_overflow_falls_back(config):
+    # all keys identical -> one partition overflows its window -> the
+    # lax.cond gather fallback answers presence for the whole batch
+    key = b"dup-key-16-bytes"
+    batch = [key] * 600
+    ku, lu = pack_keys(batch, config.key_len)
+    _, present = _run_test_insert(config, ku, lu, _zeros(config))
+    assert not present.any(), "key absent before the batch"
+    # now it IS present: second identical batch must report all-True
+    fn = jax.jit(make_sweep_insert_fn(config, interpret=True, with_presence=True))
+    blocks, _ = fn(_zeros(config), jnp.asarray(ku), jnp.asarray(lu))
+    _, present2 = fn(blocks, jnp.asarray(ku), jnp.asarray(lu))
+    assert np.asarray(present2).all()
+
+
+def test_filter_class_return_presence():
+    config = FilterConfig(m=1 << 22, k=7, key_len=16, block_bits=512,
+                          insert_path="scatter")
+    from tpubloom.filter import BlockedBloomFilter
+
+    f = BlockedBloomFilter(config)
+    rng = np.random.default_rng(8)
+    keys = [rng.bytes(16) for _ in range(200)]
+    p1 = f.insert_batch(keys, return_presence=True)
+    assert not p1.any()
+    p2 = f.insert_batch(keys, return_presence=True)
+    assert p2.all()
 
 
 def test_insert_path_config_validation():

@@ -122,19 +122,11 @@ def make_blocked_insert_fn(config: FilterConfig):
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
     k, seed = config.k, config.seed
-    path = config.insert_path
 
     def insert(blocks, keys_u8, lengths):
         from tpubloom.ops import sweep
 
-        use_sweep = path == "sweep" or (
-            path == "auto"
-            and sweep.auto_insert_path(
-                jax.default_backend(), nb, keys_u8.shape[0]
-            )
-            == "sweep"
-        )
-        if use_sweep:
+        if sweep.resolve_insert_path(config, keys_u8.shape[0]) == "sweep":
             return sweep.make_sweep_insert_fn(config)(blocks, keys_u8, lengths)
         valid = lengths >= 0
         blk, bit = blocked.block_positions(
@@ -145,6 +137,42 @@ def make_blocked_insert_fn(config: FilterConfig):
         return blocked.blocked_insert(blocks, blk, masks, valid)
 
     return insert
+
+
+def make_blocked_test_insert_fn(config: FilterConfig):
+    """Pure ``(blocks, keys_u8, lengths) -> (blocks, present[B])``
+    test-and-insert for the blocked layout: ``present[i]`` is key i's
+    membership BEFORE this batch (within-batch duplicates all report the
+    pre-batch state; padded entries report False).
+
+    Parity: the reference's Lua add script returns prior membership from
+    the same server-side pass that sets the bits (SURVEY.md §2.1 ":lua"
+    driver row); this is that fused hot path. On TPU the sweep kernel
+    answers membership from the partition tile it is already updating —
+    measurably faster than separate query + insert steps.
+    """
+    nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
+    k, seed = config.k, config.seed
+
+    def test_insert(blocks, keys_u8, lengths):
+        from tpubloom.ops import sweep
+
+        if sweep.resolve_insert_path(config, keys_u8.shape[0]) == "sweep":
+            return sweep.make_sweep_insert_fn(config, with_presence=True)(
+                blocks, keys_u8, lengths
+            )
+        # scatter path: hash once, reuse positions for both the
+        # membership test and the insert
+        valid = lengths >= 0
+        blk, bit = blocked.block_positions(
+            keys_u8, jnp.maximum(lengths, 0),
+            n_blocks=nb, block_bits=bb, k=k, seed=seed,
+        )
+        masks = blocked.build_masks(bit, w)
+        present = blocked.blocked_query(blocks, blk, masks) & valid
+        return blocked.blocked_insert(blocks, blk, masks, valid), present
+
+    return test_insert
 
 
 def make_blocked_query_fn(config: FilterConfig):
@@ -299,6 +327,26 @@ class BlockedBloomFilter(_FilterBase):
         )
         self._insert = jax.jit(make_blocked_insert_fn(config), donate_argnums=0)
         self._query = jax.jit(make_blocked_query_fn(config))
+        self._test_insert = None  # jitted lazily on first return_presence use
+
+    def insert_batch(
+        self, keys: Sequence[bytes | str], *, return_presence: bool = False
+    ):
+        """Insert a batch; with ``return_presence`` also report each key's
+        membership BEFORE the batch (test-and-insert, one fused device
+        pass on the sweep path — the reference Lua add script's
+        semantics). Within-batch duplicates all report the pre-batch
+        state."""
+        if not return_presence:
+            return super().insert_batch(keys)
+        if self._test_insert is None:
+            self._test_insert = jax.jit(
+                make_blocked_test_insert_fn(self.config), donate_argnums=0
+            )
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words, present = self._test_insert(self.words, keys_u8, lengths)
+        self.n_inserted += B
+        return np.asarray(present)[:B]
 
     def stats(self) -> dict:
         return {

@@ -58,13 +58,6 @@ def _u32(x):
     return jnp.asarray(x, jnp.uint32)
 
 
-def _pow2ceil(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
-
-
 def choose_params(
     n_blocks: int, batch: int, *, R: int | None = None
 ) -> tuple[int, int]:
@@ -100,21 +93,41 @@ def choose_params(
     return R, kmax
 
 
-def auto_insert_path(backend: str, n_blocks: int, batch: int) -> str:
+def auto_insert_path(
+    backend: str, n_blocks: int, batch: int, words_per_block: int = 16
+) -> str:
     """The implementation ``insert_path="auto"`` resolves to — the single
     source of truth shared by :func:`tpubloom.filter.make_blocked_insert_fn`
     and the benchmark's metadata. The Mosaic kernel only lowers on TPU;
     every other backend (cpu, gpu, ...) takes the XLA scatter path."""
-    if backend == "tpu" and sweep_applicable(n_blocks, batch):
+    if backend == "tpu" and sweep_applicable(n_blocks, batch, words_per_block):
         return "sweep"
     return "scatter"
 
 
-def sweep_applicable(n_blocks: int, batch: int) -> bool:
+def resolve_insert_path(config, batch: int, backend: str | None = None) -> str:
+    """Resolve ``config.insert_path`` ("auto"/"sweep"/"scatter") for a
+    batch size on the current (or given) backend."""
+    if config.insert_path != "auto":
+        return config.insert_path
+    if backend is None:
+        backend = jax.default_backend()
+    return auto_insert_path(
+        backend, config.n_blocks, batch, config.words_per_block
+    )
+
+
+def sweep_applicable(
+    n_blocks: int, batch: int, words_per_block: int = 16
+) -> bool:
     """The sweep wins when the array is large enough that partitions
     outnumber DMA latency and per-partition occupancy fits the fetch
     window; tiny filters / huge-batch-tiny-filter shapes stay on the
     sorted-scatter path."""
+    if words_per_block + 2 > 128:
+        # the update-stream row holds block id + W mask words + key idx
+        # in 128 lanes; block_bits=4096 (W=128) does not fit
+        return False
     R, kmax = choose_params(n_blocks, batch)
     P = max(1, n_blocks // R)
     if n_blocks % R != 0:
@@ -132,14 +145,17 @@ def _kernel(
     starts_ref,  # SMEM [P+1] i32 (scalar prefetch)
     upd_ref,  # ANY [Btot, 128] u32: col 0 = block id, cols 1..W = mask words
     blocks_ref,  # VMEM [R, W] u32 (auto-streamed partition of the array)
-    out_ref,  # VMEM [R, W] u32
-    sup_ref,  # VMEM scratch [2, KMAX, 128] u32
-    sems,  # DMA sems [2]
-    *,
+    *rest,  # out_ref [, pres_ref], scratch sup_ref, sems
     R: int,
     KMAX: int,
     W: int,
+    PRES: bool = False,
 ):
+    if PRES:
+        out_ref, pres_ref, sup_ref, sems = rest
+    else:
+        out_ref, sup_ref, sems = rest
+        pres_ref = None
     p = pl.program_id(0)
     num_p = pl.num_programs(0)
     s0 = starts_ref[p]
@@ -212,10 +228,11 @@ def _kernel(
         jnp.float32(0),
     ).astype(jnp.bfloat16)
 
-    def chunk_delta(slot):
+    def chunk_delta(slot, want_presence=False):
         """delta[R, W] u32 word-OR contribution of the update slice in
-        `slot`. All heavy lifting happens in update space ([KMAX, *]);
-        nothing here scales with R*W*32.
+        `slot` (and, when asked, the pre-update membership of each slot).
+        All heavy lifting happens in update space ([KMAX, *]); nothing
+        here scales with R*W*32.
 
         MXU stages (all exact):
           same  = oh @ oh^T        0/1 same-row indicator   (bf16 x bf16)
@@ -277,15 +294,47 @@ def _kernel(
             delta_q, comb_hi, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        delta = lo.astype(jnp.int32).astype(jnp.uint32) | (
             hi.astype(jnp.int32).astype(jnp.uint32) << _u32(16)
         )
+        if not want_presence:
+            return delta
 
-    delta = chunk_delta(slot)
+        # -- pre-update membership of each slot (test-and-insert) ------
+        # Extract each slot's OLD block row with the same one-hot matmul,
+        # one 8-bit quarter at a time (bf16-exact <= 255), and test
+        # (row & mask) == mask across all W words and 4 quarters.
+        tile = blocks_ref[:]  # [R, W] u32, pre-update by construction
+        acc_ok = None
+        for q in range(4):
+            tq_f = (
+                ((tile >> _u32(8 * q)) & _u32(0xFF))
+                .astype(jnp.int32)
+                .astype(jnp.float32)
+                .astype(jnp.bfloat16)
+            )
+            rq = lax.dot_general(
+                oh, tq_f, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [KMAX, W] f32-exact quarter of the slot's old row
+            rq_u = rq.astype(jnp.int32).astype(jnp.uint32)
+            mq = (m >> _u32(8 * q)) & _u32(0xFF)
+            ok = jnp.where((mq & rq_u) == mq, jnp.float32(1), jnp.float32(0))
+            acc_ok = ok if acc_ok is None else acc_ok * ok
+        # all W words must match; slots with no row in this partition
+        # (oh all-zero -> row 0) produce garbage, masked by `real` below
+        hit = jnp.min(acc_ok, axis=1, keepdims=True)  # [KMAX, 1] f32
+        return delta, hit
+
+    delta, hit0 = chunk_delta(slot, want_presence=True) if PRES else (
+        chunk_delta(slot), None
+    )
 
     # overflow chunks (adversarial skew only): serial fetch + word-OR.
     # Groups spanning a chunk boundary contribute one partial merge per
-    # chunk; OR-accumulating packed words keeps that exact.
+    # chunk; OR-accumulating packed words keeps that exact. (Presence is
+    # emitted for chunk-0 windows only; the host falls back to a gather
+    # query for batches where any partition overflows.)
     nch = (end - off0 + (KMAX - 1)) // KMAX
 
     def body(c, acc):
@@ -293,6 +342,42 @@ def _kernel(
         return acc | chunk_delta(slot)
 
     delta = lax.fori_loop(1, nch, body, delta)
+
+    if PRES:
+        # Pack (idx+1 | hit<<31) per slot into an [8, KMAX/8] tile, slot
+        # j at (j % 8, j // 8). The sublane->lane move is done with four
+        # exact byte matmuls ((oh_a * v_byte)^T @ oh_b) because Mosaic
+        # supports neither the reshape nor sublane shifts.
+        buf = sup_ref[slot]
+        idxp1 = buf[:, W + 1 : W + 2]  # [KMAX, 1] u32, idx+1 (0 = filler)
+        ipos = lax.broadcasted_iota(jnp.int32, (KMAX, 1), 0) + off0
+        real = (ipos >= s0) & (ipos < end) & (idxp1 > 0)
+        hbit = jnp.where(hit0 > 0.5, _u32(0x80000000), _u32(0))
+        v = jnp.where(real, idxp1 | hbit, _u32(0))  # [KMAX, 1]
+        jj8 = lax.broadcasted_iota(jnp.int32, (KMAX, 8), 0)
+        aa8 = lax.broadcasted_iota(jnp.int32, (KMAX, 8), 1)
+        oh_a = jnp.where(jj8 % 8 == aa8, jnp.float32(1), jnp.float32(0))
+        jjc = lax.broadcasted_iota(jnp.int32, (KMAX, KMAX // 8), 0)
+        ccc = lax.broadcasted_iota(jnp.int32, (KMAX, KMAX // 8), 1)
+        oh_b = jnp.where(jjc // 8 == ccc, jnp.float32(1), jnp.float32(0)).astype(
+            jnp.bfloat16
+        )
+        pres = jnp.zeros((8, KMAX // 8), jnp.uint32)
+        for q in range(4):
+            vb = (
+                ((v >> _u32(8 * q)) & _u32(0xFF))
+                .astype(jnp.int32)
+                .astype(jnp.float32)
+            )
+            left = (oh_a * vb).astype(jnp.bfloat16)  # [KMAX, 8]
+            outq = lax.dot_general(
+                left, oh_b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [8, KMAX//8] f32-exact bytes
+            pres = pres | (
+                outq.astype(jnp.int32).astype(jnp.uint32) << _u32(8 * q)
+            )
+        pres_ref[:] = pres
 
     out_ref[:] = blocks_ref[:] | delta
 
@@ -305,22 +390,39 @@ def sweep_insert(
     R: int,
     KMAX: int,
     interpret: bool = False,
-) -> jnp.ndarray:
+    with_presence: bool = False,
+):
     """Apply sorted (block, mask) updates to ``blocks`` via the sweep kernel.
 
     Args:
       blocks: ``uint32[NB, W]``.
       updates: ``uint32[Btot, 128]`` sorted update stream: column 0 is the
         block id (ascending; padding/sentinel rows hold ``NB`` and sit at
-        the tail), columns ``1..W`` the mask words, the rest zero. The
-        128-lane row keeps every DMA slice tile-aligned. ``Btot`` must
-        include ``>= KMAX + 8`` rows of tail padding so chunk DMA windows
-        stay in bounds.
+        the tail), columns ``1..W`` the mask words, column ``W+1`` the
+        original key index + 1 when ``with_presence`` (0 = filler), the
+        rest zero. The 128-lane row keeps every DMA slice tile-aligned.
+        ``Btot`` must include ``>= KMAX + 8`` rows of tail padding so
+        chunk DMA windows stay in bounds.
       starts: ``int32[P+1]`` partition boundaries
         (``starts[p]`` = first index with ``block id >= p*R``).
+
+    Returns ``new_blocks``, or ``(new_blocks, pres)`` when
+    ``with_presence``: ``pres`` is ``uint32[P*8, KMAX//8]`` holding
+    ``idx+1 | was_present << 31`` per update slot (slot j of partition p
+    at ``[p*8 + j % 8, j // 8]``; 0 = no slot). Presence is relative to
+    the PRE-batch array and only valid when no partition overflowed its
+    chunk-0 window (callers check and fall back).
     """
     NB, W = blocks.shape
     P = NB // R
+    out_shape = jax.ShapeDtypeStruct((NB, W), jnp.uint32)
+    out_spec = pl.BlockSpec((R, W), lambda p, *_: (p, 0))
+    if with_presence:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((P * 8, KMAX // 8), jnp.uint32),
+        )
+        out_spec = (out_spec, pl.BlockSpec((8, KMAX // 8), lambda p, *_: (p, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(P,),
@@ -328,15 +430,15 @@ def sweep_insert(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
         ],
-        out_specs=pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((2, KMAX, 128), jnp.uint32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     fn = pl.pallas_call(
-        functools.partial(_kernel, R=R, KMAX=KMAX, W=W),
-        out_shape=jax.ShapeDtypeStruct((NB, W), jnp.uint32),
+        functools.partial(_kernel, R=R, KMAX=KMAX, W=W, PRES=with_presence),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         input_output_aliases={2: 0},
         interpret=interpret,
@@ -346,8 +448,11 @@ def sweep_insert(
 
 def _pack_positions(bit: jnp.ndarray, block_bits: int, k: int):
     """Pack ``uint32[B, k]`` in-block positions into few u32 payload columns
-    for the sort (9 bits each at block_bits=512); returns a tuple of u32
-    columns. Falls back to one column per position when k*log2(bb) > 64."""
+    for the sort (9 bits each at block_bits=512). Returns
+    ``(cols, nbits, packed)``; when ``k*log2(bb) > 64`` the positions ride
+    the sort as one column each (``packed=False``). The explicit flag —
+    not ``len(cols)`` — tells unpack which form it got (k=2 would be
+    ambiguous otherwise)."""
     nbits = max(1, (block_bits - 1).bit_length())
     if k * nbits <= 64:
         lo = jnp.zeros(bit.shape[:-1], jnp.uint32)
@@ -360,12 +465,12 @@ def _pack_positions(bit: jnp.ndarray, block_bits: int, k: int):
                     hi = hi | (bit[..., i] >> _u32(32 - sh))
             else:
                 hi = hi | (bit[..., i] << _u32(sh - 32))
-        return (lo, hi), nbits
-    return tuple(bit[..., i] for i in range(k)), nbits
+        return (lo, hi), nbits, True
+    return tuple(bit[..., i] for i in range(k)), nbits, False
 
 
-def _unpack_positions(cols, block_bits: int, k: int, nbits: int):
-    if len(cols) == k:  # unpacked fallback
+def _unpack_positions(cols, block_bits: int, k: int, nbits: int, packed: bool):
+    if not packed:
         return jnp.stack(cols, axis=-1)
     lo, hi = cols
     mask = _u32(block_bits - 1)
@@ -382,10 +487,20 @@ def _unpack_positions(cols, block_bits: int, k: int, nbits: int):
     return jnp.stack(outs, axis=-1)
 
 
-def make_sweep_insert_fn(config, *, interpret: bool | None = None):
+def make_sweep_insert_fn(
+    config, *, interpret: bool | None = None, with_presence: bool = False
+):
     """Pure ``(blocks, keys_u8, lengths) -> blocks`` blocked insert via the
     partition sweep. Bit-identical to
     :func:`tpubloom.filter.make_blocked_insert_fn` (same blocked spec).
+
+    With ``with_presence`` the function returns ``(blocks, present)``
+    where ``present[i]`` says whether key i was in the filter BEFORE this
+    batch (test-and-insert — the semantics of the reference's Lua add
+    script, which returns prior membership). Within-batch duplicates all
+    report the pre-batch state. Requires batch padding (lengths < 0) to
+    sit at the TAIL of the batch (tpubloom.filter._pack_padded
+    guarantees this); padded entries return False.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
     k, seed = config.k, config.seed
@@ -393,13 +508,13 @@ def make_sweep_insert_fn(config, *, interpret: bool | None = None):
     def insert(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
         R, KMAX = choose_params(nb, B)
-        if nb % R != 0:
-            # partitions must tile the array exactly or trailing blocks
-            # would silently never receive their updates
+        if nb % R != 0 or w + 2 > 128:
+            # partitions must tile the array exactly (or trailing blocks
+            # would silently never receive updates), and the 128-lane
+            # update row must fit block id + W mask words + key idx
             raise ValueError(
-                f"sweep insert needs a partition size dividing n_blocks; "
-                f"n_blocks={nb} is not divisible by R={R} — use "
-                f"insert_path='scatter' for this shape"
+                f"sweep insert does not support this shape (n_blocks={nb}, "
+                f"R={R}, words_per_block={w}) — use insert_path='scatter'"
             )
         P = nb // R
         interp = (
@@ -411,10 +526,14 @@ def make_sweep_insert_fn(config, *, interpret: bool | None = None):
             n_blocks=nb, block_bits=bb, k=k, seed=seed,
         )
         blk = jnp.where(valid, blk, nb)
-        cols, nbits = _pack_positions(bit, bb, k)
+        cols, nbits, packed = _pack_positions(bit, bb, k)
+        if with_presence:
+            idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = filler
+            cols = cols + (idx0,)
         sorted_cols = lax.sort((blk,) + cols, num_keys=1)
         bs = sorted_cols[0]
-        bit_sorted = _unpack_positions(sorted_cols[1:], bb, k, nbits)
+        pos_cols = sorted_cols[1:-1] if with_presence else sorted_cols[1:]
+        bit_sorted = _unpack_positions(pos_cols, bb, k, nbits, packed)
         masks = blocked.build_masks(bit_sorted, w)
         # sentinel rows must carry zero masks (their positions are real
         # hash bits of padding keys; they never reach a partition, but
@@ -428,6 +547,43 @@ def make_sweep_insert_fn(config, *, interpret: bool | None = None):
             jnp.concatenate([bs.astype(jnp.uint32), jnp.full((pad,), nb, jnp.uint32)])
         )
         upd = upd.at[:B, 1 : w + 1].set(masks)
-        return sweep_insert(blocks, upd, starts, R=R, KMAX=KMAX, interpret=interp)
+        if not with_presence:
+            return sweep_insert(
+                blocks, upd, starts, R=R, KMAX=KMAX, interpret=interp
+            )
+
+        upd = upd.at[:B, w + 1].set(sorted_cols[-1])
+        # chunk-0 windows cover [align8(starts[p]), +KMAX); a partition
+        # whose slice exceeds that emits no presence for the overflow —
+        # rare (KMAX covers lambda+8sigma; needs adversarial duplicate
+        # skew), handled by a gather-query fallback on the PRE-batch
+        # array, computed under lax.cond so the common path never pays.
+        span = starts[1:] - (starts[:-1] // _ALIGN) * _ALIGN
+        overflow = jnp.max(span) > KMAX
+
+        def gather_presence():
+            rows = blocks[jnp.minimum(blk, nb - 1)]
+            masks_orig = blocked.build_masks(bit, w)
+            hit = jnp.all((rows & masks_orig) == masks_orig, axis=-1)
+            return hit & valid & (blk < nb)
+
+        presence_fb = lax.cond(
+            overflow,
+            gather_presence,
+            lambda: jnp.zeros((B,), bool),
+        )
+        new_blocks, pres_packed = sweep_insert(
+            blocks, upd, starts,
+            R=R, KMAX=KMAX, interpret=interp, with_presence=True,
+        )
+        v = pres_packed.reshape(P, 8, KMAX // 8).transpose(0, 2, 1).reshape(-1)
+        slot_idx = jnp.where(
+            v == 0, jnp.int32(0x7FFFFFFF), (v & _u32(0x7FFFFFFF)).astype(jnp.int32) - 1
+        )
+        slot_hit = (v >> _u32(31)).astype(jnp.uint32)
+        sidx, shit = lax.sort((slot_idx, slot_hit), num_keys=1)
+        fused = shit[:B] == 1
+        present = jnp.where(overflow, presence_fb, fused)
+        return new_blocks, present
 
     return insert

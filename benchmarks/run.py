@@ -153,16 +153,24 @@ def config3(scale: float) -> dict:
         }
 
 
-def config4(scale: float) -> dict:
-    """Counting filter insert/delete/query mix."""
+def config4(scale: float, layout: str = "flat") -> dict:
+    """Counting filter insert/delete/query mix. ``--layout blocked``
+    selects the blocked counting variant (Pallas sweep hot loop on TPU,
+    ~6x the flat scatter rate on v5e)."""
     import numpy as np
 
-    from tpubloom import CountingBloomFilter, FilterConfig
+    from tpubloom import BlockedCountingBloomFilter, CountingBloomFilter, FilterConfig
 
     n = int(10_000_000 * scale)
     log2m = 30 if scale >= 0.1 else 22
-    cfg = FilterConfig(m=1 << log2m, k=7, key_len=16, counting=True)
-    f = CountingBloomFilter(cfg)
+    if layout == "blocked":
+        cfg = FilterConfig(
+            m=1 << log2m, k=7, key_len=16, counting=True, block_bits=512
+        )
+        f = BlockedCountingBloomFilter(cfg)
+    else:
+        cfg = FilterConfig(m=1 << log2m, k=7, key_len=16, counting=True)
+        f = CountingBloomFilter(cfg)
     keys_u8, _ = _gen_keys(n)
     keys = [bytes(k) for k in keys_u8]
     half = keys[: n // 2]
@@ -174,6 +182,7 @@ def config4(scale: float) -> dict:
     assert hits[n // 2 :].all()
     return {
         "config": 4,
+        "layout": layout,
         "m": cfg.m,
         "ops": 2 * n + n // 2,
         "ops_per_sec": round((2 * n + n // 2) / elapsed),
@@ -229,7 +238,7 @@ def main() -> None:
     ap.add_argument("--platform", choices=["cpu", "tpu"], default=None)
     ap.add_argument(
         "--layout", choices=["flat", "blocked"], default="flat",
-        help="filter layout for device configs 2 and 5",
+        help="filter layout for device configs 2, 4 and 5",
     )
     args = ap.parse_args()
 
@@ -242,7 +251,7 @@ def main() -> None:
     on_tpu = jax.default_backend() not in ("cpu",)
     scale = args.scale if args.scale is not None else (1.0 if on_tpu else 0.001)
 
-    if args.config in (2, 5):
+    if args.config in (2, 4, 5):
         result = CONFIGS[args.config](scale, layout=args.layout)
     else:
         result = CONFIGS[args.config](scale)

@@ -139,8 +139,13 @@ def test_default_block_bits():
 def test_config_validation():
     with pytest.raises(ValueError, match="power of two"):
         FilterConfig(m=1 << 16, k=7, block_bits=300)
-    with pytest.raises(ValueError, match="counting"):
-        FilterConfig(m=1 << 16, k=7, block_bits=512, counting=True)
+    # blocked counting is supported; m counts counters and must cover a
+    # whole number of blocks (block_bits/4 counters each)
+    with pytest.raises(ValueError, match="counters per block"):
+        FilterConfig(m=64, k=7, block_bits=512, counting=True)
+    assert FilterConfig(
+        m=1 << 16, k=7, block_bits=512, counting=True
+    ).n_blocks == (1 << 16) // 128
     with pytest.raises(ValueError, match="power-of-two m"):
         FilterConfig(m=96, k=7, block_bits=512)
 

@@ -20,7 +20,12 @@ was empty at survey time, so parity targets come from BASELINE.json):
 from tpubloom.version import __version__
 from tpubloom.params import optimal_m_k, theoretical_fpr
 from tpubloom.config import FilterConfig
-from tpubloom.filter import BlockedBloomFilter, BloomFilter, CountingBloomFilter
+from tpubloom.filter import (
+    BlockedBloomFilter,
+    BlockedCountingBloomFilter,
+    BloomFilter,
+    CountingBloomFilter,
+)
 from tpubloom.cpu_ref import CPUBlockedBloomFilter, CPUBloomFilter
 from tpubloom.scalable import CPUScalableBloomFilter, ScalableBloomFilter
 
@@ -32,6 +37,7 @@ __all__ = [
     "BloomFilter",
     "BlockedBloomFilter",
     "CountingBloomFilter",
+    "BlockedCountingBloomFilter",
     "CPUBloomFilter",
     "CPUBlockedBloomFilter",
     "ScalableBloomFilter",

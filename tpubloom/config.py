@@ -134,15 +134,31 @@ class FilterConfig:
                 )
             if not self.m_is_pow2:
                 raise ValueError("blocked layout requires power-of-two m")
-            if self.m < bb:
-                raise ValueError(f"m ({self.m}) must be >= block_bits ({bb})")
             if self.counting:
-                raise ValueError("blocked layout does not support counting filters")
-            if self.m % (self.shards * bb) != 0:
-                raise ValueError(
-                    f"m ({self.m}) must be divisible by shards*block_bits "
-                    f"({self.shards * bb})"
-                )
+                # blocked counting: a block_bits-bit block holds
+                # block_bits/4 counters; m counts COUNTERS (as in the
+                # flat counting layout) and must be < 2^31 (positions
+                # flatten to blk * counters_per_block + c for the flat
+                # counting kernels / oracle)
+                if self.m < bb // 4:
+                    raise ValueError(
+                        f"m ({self.m}) must be >= counters per block ({bb // 4})"
+                    )
+                if self.m % (self.shards * (bb // 4)) != 0:
+                    raise ValueError(
+                        f"m ({self.m}) must be divisible by "
+                        f"shards*counters_per_block ({self.shards * (bb // 4)})"
+                    )
+            else:
+                if self.m < bb:
+                    raise ValueError(
+                        f"m ({self.m}) must be >= block_bits ({bb})"
+                    )
+                if self.m % (self.shards * bb) != 0:
+                    raise ValueError(
+                        f"m ({self.m}) must be divisible by shards*block_bits "
+                        f"({self.shards * bb})"
+                    )
 
     # -- derived layout ----------------------------------------------------
 
@@ -167,10 +183,22 @@ class FilterConfig:
         return (self.m + 7) // 8
 
     @property
+    def counters_per_block(self) -> int:
+        """4-bit counters per block (blocked counting layout)."""
+        if not self.block_bits or not self.counting:
+            raise ValueError(
+                "counters_per_block is only defined for blocked counting layouts"
+            )
+        return self.block_bits // 4
+
+    @property
     def n_blocks(self) -> int:
-        """Number of blocks (blocked layout only)."""
+        """Number of blocks (blocked layout only). For blocked counting
+        filters m counts counters, so a block covers block_bits/4 of them."""
         if not self.block_bits:
             raise ValueError("n_blocks is only defined for blocked layouts")
+        if self.counting:
+            return self.m // self.counters_per_block
         return self.m // self.block_bits
 
     @property

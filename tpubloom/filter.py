@@ -139,6 +139,72 @@ def make_blocked_insert_fn(config: FilterConfig):
     return insert
 
 
+def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
+    """Pure ``(blocks[NB,W], keys_u8, lengths) -> blocks`` update for the
+    BLOCKED counting layout: all k 4-bit counters of a key live in one
+    block (block_bits bits = block_bits/4 counters), so the sweep path
+    touches one row per key instead of k scattered words.
+
+    Position spec: ``blk`` as in ops.blocked; counter ``c_i = p_i mod
+    counters_per_block``. The storage is bit-identical to the flat
+    counting layout at positions ``blk * counters_per_block + c`` —
+    which is exactly what the non-sweep fallback (and the CPU oracle)
+    computes via ops.counting.counter_update on the raveled array.
+    """
+    nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
+    k, seed = config.k, config.seed
+
+    def update(blocks, keys_u8, lengths):
+        from tpubloom.ops import sweep
+
+        if sweep.resolve_insert_path(config, keys_u8.shape[0]) == "sweep":
+            if k > 15:
+                # per-key multiplicity must fit the 4-bit stream nibbles
+                if config.insert_path == "sweep":
+                    raise ValueError(
+                        "counting sweep supports k <= 15 — use "
+                        "insert_path='scatter' (auto falls back silently)"
+                    )
+            else:
+                return sweep.make_sweep_counter_fn(
+                    config, increment=increment
+                )(blocks, keys_u8, lengths)
+        valid = lengths >= 0
+        blk, cpos = blocked.block_positions(
+            keys_u8, jnp.maximum(lengths, 0),
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+        )
+        gpos = (blk[..., None] * cpb + cpos.astype(jnp.int32)).astype(jnp.int32)
+        valid_k = jnp.broadcast_to(valid[..., None], gpos.shape)
+        flat = counting.counter_update(
+            blocks.reshape(-1), gpos.ravel(), valid_k.ravel(), increment=increment
+        )
+        return flat.reshape(nb, w)
+
+    return update
+
+
+def make_blocked_counting_query_fn(config: FilterConfig):
+    """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked-counting
+    membership: one row gather per key + all-counters-nonzero test."""
+    nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
+    k, seed = config.k, config.seed
+
+    def query(blocks, keys_u8, lengths):
+        blk, cpos = blocked.block_positions(
+            keys_u8, jnp.maximum(lengths, 0),
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+        )
+        rows = blocks[blk]  # [B, W]
+        word = (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k] in [0, W)
+        nib = (cpos & jnp.uint32(7)) * jnp.uint32(4)
+        vals = jnp.take_along_axis(rows, word, axis=-1)
+        cnt = (vals >> nib) & jnp.uint32(15)
+        return jnp.all(cnt > 0, axis=-1)
+
+    return query
+
+
 def make_blocked_test_insert_fn(config: FilterConfig):
     """Pure ``(blocks, keys_u8, lengths) -> (blocks, present[B])``
     test-and-insert for the blocked layout: ``present[i]`` is key i's
@@ -367,6 +433,70 @@ class BlockedBloomFilter(_FilterBase):
 
     @classmethod
     def from_bytes(cls, config: FilterConfig, data: bytes) -> "BlockedBloomFilter":
+        f = cls(config)
+        arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
+        f.words = jnp.asarray(
+            arr.reshape(f.config.n_blocks, f.config.words_per_block)
+        )
+        return f
+
+
+class BlockedCountingBloomFilter(_FilterBase):
+    """Blocked (cache-line) counting filter — delete support at the
+    blocked layout's throughput.
+
+    All k 4-bit counters of a key live in one ``block_bits``-bit block
+    (``block_bits/4`` counters), so updates/queries touch one contiguous
+    row instead of k scattered words; on TPU the insert/delete hot loop
+    runs as the Pallas counting sweep (``tpubloom.ops.sweep``). ``m``
+    counts COUNTERS, as in :class:`CountingBloomFilter`. Same saturation
+    semantics (increments clamp at 15, decrements floor at 0, one clamp
+    per batch against the pre-batch value).
+    """
+
+    def __init__(self, config: FilterConfig):
+        if not config.counting:
+            config = config.replace(counting=True)
+        if not config.block_bits:
+            config = config.replace(block_bits=512)
+        if config.m >= (1 << 31):
+            raise ValueError("counting filters support m < 2^31")
+        super().__init__(config, 0)  # storage is 2-D
+        self.words = jnp.zeros(
+            (config.n_blocks, config.words_per_block), jnp.uint32
+        )
+        self._insert = jax.jit(
+            make_blocked_counter_fn(config, increment=True), donate_argnums=0
+        )
+        self._delete = jax.jit(
+            make_blocked_counter_fn(config, increment=False), donate_argnums=0
+        )
+        self._query = jax.jit(make_blocked_counting_query_fn(config))
+
+    def delete_batch(self, keys: Sequence[bytes | str]) -> None:
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words = self._delete(self.words, keys_u8, lengths)
+        self.n_inserted = max(0, self.n_inserted - B)
+
+    def delete(self, key: bytes | str) -> None:
+        self.delete_batch([key])
+
+    def stats(self) -> dict:
+        return {
+            "m": self.config.m,
+            "k": self.config.k,
+            "block_bits": self.config.block_bits,
+            "n_inserted": self.n_inserted,
+            "n_queried": self.n_queried,
+        }
+
+    def to_bytes(self) -> bytes:
+        return np.asarray(self.words).astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(
+        cls, config: FilterConfig, data: bytes
+    ) -> "BlockedCountingBloomFilter":
         f = cls(config)
         arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
         f.words = jnp.asarray(

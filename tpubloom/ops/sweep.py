@@ -446,6 +446,242 @@ def sweep_insert(
     return fn(starts, updates, blocks)
 
 
+def _stream_scaffold(bs, nb: int, P: int, R: int, KMAX: int):
+    """Shared host-side sweep-stream assembly: partition boundaries from
+    the sorted block ids, plus the padded 128-lane update buffer with
+    column 0 = block id (sentinel ``nb`` rows in the tail slack so every
+    8-aligned chunk DMA window stays in bounds). Callers fill their
+    payload columns into the returned buffer."""
+    B = bs.shape[0]
+    starts = jnp.searchsorted(
+        bs, (jnp.arange(P + 1, dtype=jnp.int32) * R).astype(jnp.int32)
+    ).astype(jnp.int32)
+    pad = KMAX + _ALIGN
+    upd = jnp.zeros((B + pad, 128), jnp.uint32)
+    upd = upd.at[:, 0].set(
+        jnp.concatenate([bs.astype(jnp.uint32), jnp.full((pad,), nb, jnp.uint32)])
+    )
+    return starts, upd
+
+
+def _count_kernel(
+    starts_ref,  # SMEM [P+1] i32 (scalar prefetch)
+    upd_ref,  # ANY [Btot, 128] u32: col 0 = block id, cols 1..W = nibble counts
+    blocks_ref,  # VMEM [R, W] u32 (auto-streamed partition of the counters)
+    out_ref,  # VMEM [R, W] u32
+    sup_ref,  # VMEM scratch [2, KMAX, 128] u32
+    sems,  # DMA sems [2]
+    *,
+    R: int,
+    KMAX: int,
+    W: int,
+    INCREMENT: bool,
+):
+    """Blocked-counting partition sweep: saturating nibble add/subtract.
+
+    Per update slot the stream carries the key's per-counter multiplicity
+    pre-packed as 4-bit nibbles in W words — the SAME (word, nibble)
+    layout as the counter storage itself, so one concat-and-shift
+    unpacks either side. Counts are additive, so no same-row merge or
+    representative selection is needed: counts[R, 128 planes] is one
+    exact one-hot matmul, accumulated over overflow chunks (clamped at
+    16 per chunk — already saturating/flooring, and it keeps every f32
+    sum exact under adversarial duplicate skew). The tile is fully
+    rewritten with min(15, old + cnt) (insert) / max(0, old - cnt)
+    (delete) — identical one-clamp semantics to ops.counting
+    (cpu_ref._counter_add ground truth).
+    """
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+    s0 = starts_ref[p]
+    off0 = (s0 // _ALIGN) * _ALIGN
+    end = starts_ref[p + 1]
+
+    def fetch(slot, off):
+        cp = pltpu.make_async_copy(
+            upd_ref.at[pl.ds(off, KMAX), :], sup_ref.at[slot], sems.at[slot]
+        )
+        cp.start()
+        return cp
+
+    def wait(slot):
+        pltpu.make_async_copy(
+            upd_ref.at[pl.ds(0, KMAX), :], sup_ref.at[slot], sems.at[slot]
+        ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, off0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, (starts_ref[p + 1] // _ALIGN) * _ALIGN)
+
+    wait(slot)
+
+    CPB = W * 8  # counters per block = nibble planes
+    colC = lax.broadcasted_iota(jnp.int32, (KMAX, CPB), 1)
+    colsR = lax.broadcasted_iota(jnp.int32, (KMAX, R), 1)
+    base = jnp.uint32(p * R)
+
+    def chunk_counts(slot):
+        """Clamped per-(row, plane) multiplicities from the slot buffers."""
+        buf = sup_ref[slot]  # [KMAX, 128] u32
+        rl = (buf[:, 0:1] - base).astype(jnp.int32)
+        ohf = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+        oh = ohf.astype(jnp.bfloat16)  # [KMAX, R]
+        m = buf[:, 1 : W + 1]  # [KMAX, W] packed 4-bit multiplicities
+        # plane c = (nibble c // W) of word (c mod W) — concat W-wide
+        # copies, shift each lane by 4 * (c // W)
+        rep = jnp.concatenate([m] * 8, axis=1)  # [KMAX, CPB]
+        nib = (rep >> ((colC // W).astype(jnp.uint32) * _u32(4))) & _u32(15)
+        nibf = nib.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+        cnts = lax.dot_general(
+            oh, nibf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, CPB], exact (<= 15 * KMAX < 2^24)
+        return jnp.minimum(cnts, jnp.float32(16))
+
+    acc = chunk_counts(slot)
+    nch = (end - off0 + (KMAX - 1)) // KMAX
+
+    def body(c, a):
+        fetch(slot, off0 + c * KMAX).wait()
+        return a + chunk_counts(slot)
+
+    acc = lax.fori_loop(1, nch, body, acc)
+
+    # old counters, same plane layout
+    tile = blocks_ref[:]
+    trep = jnp.concatenate([tile] * 8, axis=1)  # [R, CPB]
+    tcolC = lax.broadcasted_iota(jnp.int32, (R, CPB), 1)
+    old = (trep >> ((tcolC // W).astype(jnp.uint32) * _u32(4))) & _u32(15)
+    oldf = old.astype(jnp.int32).astype(jnp.float32)
+    if INCREMENT:
+        new = jnp.minimum(oldf + acc, jnp.float32(15))
+    else:
+        new = jnp.maximum(oldf - acc, jnp.float32(0))
+    newb = new.astype(jnp.bfloat16)  # <= 15, bf16-exact
+
+    # pack planes back into words: byte q of word w = plane(2q, w) +
+    # 16 * plane(2q+1, w); four separate matmuls (no lane slicing)
+    pc = lax.broadcasted_iota(jnp.int32, (CPB, W), 0)
+    pw = lax.broadcasted_iota(jnp.int32, (CPB, W), 1)
+    n_of = pc // W
+    w_of = lax.rem(pc, W)
+    packed = jnp.zeros((R, W), jnp.uint32)
+    for q in range(4):
+        wq = jnp.where(
+            (w_of == pw) & (n_of // 2 == q),
+            jnp.where(lax.rem(n_of, 2) == 0, jnp.float32(1), jnp.float32(16)),
+            jnp.float32(0),
+        ).astype(jnp.bfloat16)
+        byte = lax.dot_general(
+            newb, wq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, W] f32-exact bytes
+        packed = packed | (
+            byte.astype(jnp.int32).astype(jnp.uint32) << _u32(8 * q)
+        )
+    out_ref[:] = packed
+
+
+def sweep_counter_update(
+    blocks: jnp.ndarray,
+    updates: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    R: int,
+    KMAX: int,
+    increment: bool,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Apply sorted per-block nibble-count updates to the packed counters."""
+    NB, W = blocks.shape
+    P = NB // R
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, KMAX, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _count_kernel, R=R, KMAX=KMAX, W=W, INCREMENT=increment
+        ),
+        out_shape=jax.ShapeDtypeStruct((NB, W), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )
+    return fn(starts, updates, blocks)
+
+
+def make_sweep_counter_fn(
+    config, *, increment: bool, interpret: bool | None = None
+):
+    """Pure ``(blocks[NB,W], keys_u8, lengths) -> blocks`` blocked-counting
+    update (insert = saturating +1 per counter occurrence, delete =
+    flooring -1) via the partition sweep. Bit-identical to the flat
+    counting kernel applied at positions ``blk * counters_per_block + c``
+    (tpubloom.filter.make_blocked_counter_fn's fallback path).
+    """
+    nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
+    k, seed = config.k, config.seed
+
+    def update(blocks, keys_u8, lengths):
+        B = keys_u8.shape[0]
+        R, KMAX = choose_params(nb, B)
+        if nb % R != 0 or w + 1 > 128:
+            raise ValueError(
+                f"sweep counter update does not support this shape "
+                f"(n_blocks={nb}, R={R}, words_per_block={w})"
+            )
+        P = nb // R
+        interp = (
+            jax.default_backend() == "cpu" if interpret is None else interpret
+        )
+        valid = lengths >= 0
+        blk, cpos = blocked.block_positions(
+            keys_u8, jnp.maximum(lengths, 0),
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+        )
+        blk = jnp.where(valid, blk, nb)
+        cols, nbits, packed = _pack_positions(cpos, cpb, k)
+        sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+        bs = sorted_cols[0]
+        cpos_s = _unpack_positions(sorted_cols[1:], cpb, k, nbits, packed)
+        # per-key multiplicity of each counter, packed 4 bits per nibble
+        # in the counter-storage (word, nibble) layout: counter c lives
+        # in word c >> 3, nibble c & 7 — multiplicity <= k = {k} <= 15
+        planes = jnp.zeros(
+            (B, cpb), jnp.uint32
+        )
+        iota_c = lax.broadcasted_iota(jnp.uint32, (B, cpb), 1)
+        for i in range(k):
+            planes = planes + (cpos_s[:, i : i + 1] == iota_c).astype(jnp.uint32)
+        pw = planes.reshape(B, w, 8)
+        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4)[None, None, :]
+        cnt_words = jnp.sum(pw << shifts, axis=2, dtype=jnp.uint32)  # [B, W]
+        starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
+        upd = upd.at[:B, 1 : w + 1].set(cnt_words)
+        return sweep_counter_update(
+            blocks, upd, starts,
+            R=R, KMAX=KMAX, increment=increment, interpret=interp,
+        )
+
+    return update
+
+
 def _pack_positions(bit: jnp.ndarray, block_bits: int, k: int):
     """Pack ``uint32[B, k]`` in-block positions into few u32 payload columns
     for the sort (9 bits each at block_bits=512). Returns
@@ -516,6 +752,14 @@ def make_sweep_insert_fn(
                 f"sweep insert does not support this shape (n_blocks={nb}, "
                 f"R={R}, words_per_block={w}) — use insert_path='scatter'"
             )
+        if with_presence and (nb // R) * KMAX < B:
+            # the presence output has one slot per chunk-0 window entry;
+            # batches larger than P*KMAX cannot all be answered (auto
+            # never picks such shapes — only a forced 'sweep' gets here)
+            raise ValueError(
+                f"sweep test-and-insert needs P*KMAX >= batch "
+                f"({(nb // R) * KMAX} < {B}) — use insert_path='scatter'"
+            )
         P = nb // R
         interp = (
             jax.default_backend() == "cpu" if interpret is None else interpret
@@ -535,17 +779,10 @@ def make_sweep_insert_fn(
         pos_cols = sorted_cols[1:-1] if with_presence else sorted_cols[1:]
         bit_sorted = _unpack_positions(pos_cols, bb, k, nbits, packed)
         masks = blocked.build_masks(bit_sorted, w)
-        # sentinel rows must carry zero masks (their positions are real
-        # hash bits of padding keys; they never reach a partition, but
-        # keep the invariant obvious)
-        starts = jnp.searchsorted(
-            bs, (jnp.arange(P + 1, dtype=jnp.int32) * R).astype(jnp.int32)
-        ).astype(jnp.int32)
-        pad = KMAX + 8  # slack for the 8-aligned DMA window floor
-        upd = jnp.zeros((B + pad, 128), jnp.uint32)
-        upd = upd.at[:, 0].set(
-            jnp.concatenate([bs.astype(jnp.uint32), jnp.full((pad,), nb, jnp.uint32)])
-        )
+        # sentinel rows carry zero masks (their positions are real hash
+        # bits of padding keys; they never reach a partition, but keep
+        # the invariant obvious)
+        starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
         upd = upd.at[:B, 1 : w + 1].set(masks)
         if not with_presence:
             return sweep_insert(

@@ -70,6 +70,21 @@ class Redis
           true
         end
 
+        # Fused test-and-insert: inserts every key and returns an array of
+        # booleans — true if the key was ALREADY present before this batch
+        # (the :lua driver's add-script semantics, batched). Never
+        # auto-retried: a replay after a landed insert would report the
+        # batch's own keys as duplicates.
+        def insert_batch_was_present?(keys)
+          resp = rpc(
+            "InsertBatch",
+            { "name" => @name, "keys" => keys.map(&:to_s),
+              "return_presence" => true },
+            no_retry: true
+          )
+          unpack_bits(resp["presence"], resp["n"])
+        end
+
         def include?(key)
           include_batch?([key]).first
         end
@@ -119,9 +134,9 @@ class Redis
              (@opts[:config] || {})[:counting])
         end
 
-        def rpc(method, payload)
-          no_retry = NO_RETRY.include?(method) ||
-                     (method == "InsertBatch" && counting?)
+        def rpc(method, payload, no_retry: false)
+          no_retry ||= NO_RETRY.include?(method) ||
+                       (method == "InsertBatch" && counting?)
           retries = no_retry ? 0 : @max_retries
           attempt = 0
           recreated = false

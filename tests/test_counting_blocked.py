@@ -98,14 +98,57 @@ def test_class_surface_and_roundtrip(config):
     )
 
 
-def test_query_counts_every_position(config):
-    # membership requires ALL k counters nonzero: deleting via a
-    # different overlapping key must not resurrect membership
+def test_query_requires_all_counters(config):
+    # membership requires ALL k counters nonzero — craft the array by
+    # hand: with every counter of the key set, membership holds; zeroing
+    # any single one of them must flip it to False
+    from tpubloom.ops import blocked
+
+    key = b"all-counters-key"
+    ku, kl = pack_keys([key], config.key_len)
+    blk, cpos = jax.jit(
+        lambda k_, l_: blocked.block_positions(
+            k_, l_,
+            n_blocks=config.n_blocks,
+            block_bits=config.counters_per_block,
+            k=config.k,
+            seed=config.seed,
+        )
+    )(jnp.asarray(ku), jnp.asarray(kl))
+    blk = int(np.asarray(blk)[0])
+    counters = sorted(set(int(c) for c in np.asarray(cpos)[0]))
+    query = jax.jit(make_blocked_counting_query_fn(config))
+
+    def words_with(counters_set):
+        w = np.zeros((config.n_blocks, config.words_per_block), np.uint32)
+        for c in counters_set:
+            w[blk, c >> 3] |= np.uint32(1) << np.uint32(4 * (c & 7))
+        return jnp.asarray(w)
+
+    assert bool(np.asarray(query(words_with(counters), ku, kl))[0])
+    for drop in counters:
+        present = np.asarray(
+            query(words_with([c for c in counters if c != drop]), ku, kl)
+        )[0]
+        assert not present, f"missing counter {drop} must fail membership"
+
+
+def test_checkpoint_restore_builds_blocked_counting(config, tmp_path):
+    # config-driven restore must reconstruct the BLOCKED counting variant
+    # (a flat CountingBloomFilter would use the wrong position spec)
+    from tpubloom import checkpoint as ckpt
+
     f = BlockedCountingBloomFilter(config)
-    f.insert_batch([b"abc"])
-    assert f.include(b"abc")
-    f.delete_batch([b"abc"])
-    assert not f.include(b"abc")
+    rng = np.random.default_rng(3)
+    keys = [rng.bytes(16) for _ in range(300)]
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    g = ckpt.restore(config, sink)
+    assert isinstance(g, BlockedCountingBloomFilter)
+    assert g.include_batch(keys).all()
+    g.delete_batch(keys)
+    assert not g.include_batch(keys).any()
 
 
 @settings(max_examples=10, deadline=None)

@@ -48,6 +48,29 @@ def test_end_to_end_roundtrip(server):
     assert not client.include_batch("urls", keys[:100]).any()
 
 
+def test_insert_with_presence(server):
+    client, _, _ = server
+    client.create_filter(
+        "dedup",
+        config={"m": 1 << 22, "k": 7, "key_len": 16, "block_bits": 512},
+    )
+    rng = np.random.default_rng(1)
+    keys = _rand_keys(2000, rng)
+    p1 = client.insert_batch("dedup", keys, return_presence=True)
+    assert p1.dtype == bool and p1.shape == (2000,)
+    assert not p1.any()
+    p2 = client.insert_batch("dedup", keys[:500] + _rand_keys(500, rng),
+                             return_presence=True)
+    assert p2[:500].all()
+    assert p2[500:].sum() <= 2  # fresh keys: ~no false positives
+    # plain (non-blocked) filters take the query-then-insert fallback
+    client.create_filter("plain", capacity=10_000, error_rate=0.01)
+    q1 = client.insert_batch("plain", keys[:100], return_presence=True)
+    assert not q1.any()
+    q2 = client.insert_batch("plain", keys[:100], return_presence=True)
+    assert q2.all()
+
+
 def test_scalar_and_str_keys(server):
     client, _, _ = server
     client.create_filter("mix", capacity=1000, error_rate=0.01)

@@ -211,8 +211,9 @@ def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] =
 def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
     """Rebuild a filter from the newest (or given) checkpoint in ``sink``.
 
-    Returns a BloomFilter / CountingBloomFilter / ShardedBloomFilter
-    according to ``config``, or None if the sink has no checkpoint.
+    Returns a BloomFilter / BlockedBloomFilter / CountingBloomFilter /
+    BlockedCountingBloomFilter / ShardedBloomFilter according to
+    ``config``, or None if the sink has no checkpoint.
     Config identity (m, k, seed, counting) must match the checkpoint —
     positions are only portable between identical hash configs.
     """
@@ -231,7 +232,15 @@ def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
             f"requested={getattr(config, field)}"
         )
     words = payload_to_words(config, header, payload)
-    if config.counting:
+    if config.counting and config.block_bits:
+        from tpubloom.filter import BlockedCountingBloomFilter
+        import jax.numpy as jnp
+
+        f = BlockedCountingBloomFilter(config)
+        f.words = jnp.asarray(words).reshape(
+            config.n_blocks, config.words_per_block
+        )
+    elif config.counting:
         from tpubloom.filter import CountingBloomFilter
 
         f = CountingBloomFilter(config)

@@ -723,6 +723,44 @@ def _unpack_positions(cols, block_bits: int, k: int, nbits: int, packed: bool):
     return jnp.stack(outs, axis=-1)
 
 
+def apply_blocked_updates(
+    blocks: jnp.ndarray,
+    blk: jnp.ndarray,
+    bit: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    block_bits: int,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """OR each valid key's blocked-spec bits into ``blocks`` via the sweep.
+
+    The kernel-facing entry point shared by the single-chip path and the
+    sharded per-device path (which routes keys first and passes
+    device-local row ids). ``blk int32[B]``, ``bit uint32[B, k]``
+    (in-block positions), ``valid bool[B]``; invalid keys are dropped.
+    """
+    nb, w = blocks.shape
+    B = blk.shape[0]
+    k = bit.shape[-1]
+    R, KMAX = choose_params(nb, B)
+    if nb % R != 0 or w + 2 > 128:
+        raise ValueError(
+            f"sweep insert does not support this shape (n_blocks={nb}, "
+            f"R={R}, words_per_block={w}) — use insert_path='scatter'"
+        )
+    P = nb // R
+    interp = jax.default_backend() == "cpu" if interpret is None else interpret
+    blk = jnp.where(valid, blk, nb)
+    cols, nbits, packed = _pack_positions(bit, block_bits, k)
+    sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+    bs = sorted_cols[0]
+    bit_sorted = _unpack_positions(sorted_cols[1:], block_bits, k, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, w)
+    starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
+    upd = upd.at[:B, 1 : w + 1].set(masks)
+    return sweep_insert(blocks, upd, starts, R=R, KMAX=KMAX, interpret=interp)
+
+
 def make_sweep_insert_fn(
     config, *, interpret: bool | None = None, with_presence: bool = False
 ):
@@ -769,25 +807,23 @@ def make_sweep_insert_fn(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=bb, k=k, seed=seed,
         )
+        if not with_presence:
+            return apply_blocked_updates(
+                blocks, blk, bit, valid, block_bits=bb, interpret=interpret
+            )
         blk = jnp.where(valid, blk, nb)
         cols, nbits, packed = _pack_positions(bit, bb, k)
-        if with_presence:
-            idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = filler
-            cols = cols + (idx0,)
+        idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = filler
+        cols = cols + (idx0,)
         sorted_cols = lax.sort((blk,) + cols, num_keys=1)
         bs = sorted_cols[0]
-        pos_cols = sorted_cols[1:-1] if with_presence else sorted_cols[1:]
-        bit_sorted = _unpack_positions(pos_cols, bb, k, nbits, packed)
+        bit_sorted = _unpack_positions(sorted_cols[1:-1], bb, k, nbits, packed)
         masks = blocked.build_masks(bit_sorted, w)
         # sentinel rows carry zero masks (their positions are real hash
         # bits of padding keys; they never reach a partition, but keep
         # the invariant obvious)
         starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
         upd = upd.at[:B, 1 : w + 1].set(masks)
-        if not with_presence:
-            return sweep_insert(
-                blocks, upd, starts, R=R, KMAX=KMAX, interpret=interp
-            )
 
         upd = upd.at[:B, w + 1].set(sorted_cols[-1])
         # chunk-0 windows cover [align8(starts[p]), +KMAX); a partition

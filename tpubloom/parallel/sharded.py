@@ -140,11 +140,15 @@ def make_sharded_query_fn(config: FilterConfig, mesh: Mesh):
     )
 
 
-def _routed_blocks(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
+def _routed_blocks(
+    config: FilterConfig, shards_per_dev: int, keys_u8, lengths, *, want_bit=False
+):
     """Blocked-layout preamble: route keys to shards, then to this device's
-    local block rows. Returns ``(blk[B], masks[B, W], owned[B])`` with
-    ``blk`` indexing the device-local ``[shards_per_dev * n_blocks_local]``
-    row space (clamped to 0 for unowned keys)."""
+    local block rows. Returns ``(blk[B], masks[B, W], owned[B])`` (plus the
+    raw in-block positions when ``want_bit`` — the sweep path re-sorts and
+    rebuilds masks itself) with ``blk`` indexing the device-local
+    ``[shards_per_dev * n_blocks_local]`` row space (clamped to 0 for
+    unowned keys)."""
     nbl = config.n_blocks_per_shard
     dev = jax.lax.axis_index(AXIS)
     lens = jnp.maximum(lengths, 0)
@@ -160,19 +164,43 @@ def _routed_blocks(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
     local_row = route - dev * shards_per_dev
     owned = (local_row >= 0) & (local_row < shards_per_dev) & (lengths >= 0)
     blk = blk + jnp.where(owned, local_row, 0) * nbl
+    if want_bit:
+        return blk, masks, owned, bit
     return blk, masks, owned
 
 
 def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
     """Blocked-layout sharded insert: ``(blocks[S, NBL, W], keys, lengths)``
-    with ``blocks`` sharded over ``shards``; one row RMW per owned key."""
+    with ``blocks`` sharded over ``shards``; one row RMW per owned key.
+    On TPU the per-device hot loop runs the Pallas partition sweep
+    (pallas_call inside shard_map) when the local shape qualifies."""
     shards_per_dev = config.shards // mesh.devices.size
+    local_rows = shards_per_dev * config.n_blocks_per_shard
 
     def local_insert(blocks_block, keys_u8, lengths):
+        from tpubloom.ops import sweep
+
         # blocks_block: [shards_per_dev, n_blocks_local, W] — local rows.
-        blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
+        blk, masks, owned, bit = _routed_blocks(
+            config, shards_per_dev, keys_u8, lengths, want_bit=True
+        )
         flat = blocks_block.reshape(-1, config.words_per_block)
-        flat = blocked.blocked_insert(flat, blk, masks, owned)
+        use_sweep = config.insert_path == "sweep" or (
+            config.insert_path == "auto"
+            and sweep.auto_insert_path(
+                jax.default_backend(),
+                local_rows,
+                keys_u8.shape[0],
+                config.words_per_block,
+            )
+            == "sweep"
+        )
+        if use_sweep:
+            flat = sweep.apply_blocked_updates(
+                flat, blk, bit, owned, block_bits=config.block_bits
+            )
+        else:
+            flat = blocked.blocked_insert(flat, blk, masks, owned)
         return flat.reshape(blocks_block.shape)
 
     return shard_map(
@@ -180,6 +208,9 @@ def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
         mesh=mesh,
         in_specs=(P(AXIS, None, None), P(), P()),
         out_specs=P(AXIS, None, None),
+        # pallas_call outputs cannot carry vma metadata; the local insert
+        # has no collectives, so the varying-axes lint has nothing to check
+        check_vma=False,
     )
 
 

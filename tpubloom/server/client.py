@@ -89,12 +89,12 @@ class BloomClient:
             or creation.get("options", {}).get("counting")
         )
 
-    def _rpc(self, method: str, req: dict) -> dict:
+    def _rpc(self, method: str, req: dict, *, force_no_retry: bool = False) -> dict:
         # Counting-filter inserts are scatter-ADDs, not idempotent OR —
         # a replayed insert that DID land double-increments counters, so a
         # later delete leaves residue (stuck false positives). Same reason
         # DeleteBatch is never retried.
-        no_retry = method in _NO_RETRY or (
+        no_retry = force_no_retry or method in _NO_RETRY or (
             method == "InsertBatch" and self._maybe_counting(req.get("name", ""))
         )
         retries = 0 if no_retry else self.max_retries
@@ -186,8 +186,27 @@ class BloomClient:
     def _keys(keys: Sequence[bytes | str]) -> list:
         return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
 
-    def insert_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
-        return self._rpc("InsertBatch", {"name": name, "keys": self._keys(keys)})["n"]
+    def insert_batch(
+        self,
+        name: str,
+        keys: Sequence[bytes | str],
+        *,
+        return_presence: bool = False,
+    ):
+        """Insert a batch; with ``return_presence`` also get each key's
+        membership BEFORE the batch (fused test-and-insert server-side —
+        the dedup primitive). Returns the insert count, or the presence
+        bool array when requested."""
+        req = {"name": name, "keys": self._keys(keys)}
+        if not return_presence:
+            return self._rpc("InsertBatch", req)["n"]
+        req["return_presence"] = True
+        # never auto-retried: a replay after an insert that DID land
+        # would report the batch's own keys as pre-existing duplicates
+        resp = self._rpc("InsertBatch", req, force_no_retry=True)
+        return np.unpackbits(
+            np.frombuffer(resp["presence"], np.uint8), count=resp["n"]
+        ).astype(bool)
 
     def include_batch(self, name: str, keys: Sequence[bytes | str]) -> np.ndarray:
         resp = self._rpc("QueryBatch", {"name": name, "keys": self._keys(keys)})

@@ -135,6 +135,10 @@ class BloomService:
                     raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
             if restored is not None:
                 filt = restored
+            elif config.counting and config.block_bits:
+                from tpubloom.filter import BlockedCountingBloomFilter
+
+                filt = BlockedCountingBloomFilter(config)
             elif config.counting:
                 filt = CountingBloomFilter(config)
             elif config.shards > 1:
@@ -184,12 +188,28 @@ class BloomService:
 
     def InsertBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
+        want_presence = bool(req.get("return_presence"))
         with mf.lock, tracing.annotate("InsertBatch", batch=len(req["keys"])):
-            mf.filter.insert_batch(req["keys"])
+            presence = None
+            if want_presence:
+                # fused test-and-insert (blocked filters run it as one
+                # device pass; others fall back to query-then-insert)
+                try:
+                    presence = mf.filter.insert_batch(
+                        req["keys"], return_presence=True
+                    )
+                except TypeError:
+                    presence = mf.filter.include_batch(req["keys"])
+                    mf.filter.insert_batch(req["keys"])
+            else:
+                mf.filter.insert_batch(req["keys"])
             if mf.checkpointer:
                 mf.checkpointer.notify_inserts(len(req["keys"]))
         self.metrics.count("keys_inserted", len(req["keys"]))
-        return {"ok": True, "n": len(req["keys"])}
+        resp = {"ok": True, "n": len(req["keys"])}
+        if presence is not None:
+            resp["presence"] = np.packbits(np.asarray(presence)).tobytes()
+        return resp
 
     def QueryBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -201,7 +221,7 @@ class BloomService:
 
     def DeleteBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
-        if not isinstance(mf.filter, CountingBloomFilter):
+        if not hasattr(mf.filter, "delete_batch"):
             raise protocol.BloomServiceError(
                 "UNSUPPORTED", "delete requires a counting filter"
             )

@@ -61,6 +61,7 @@ def _load():
         lib.bh_hash_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32, u8p]
         lib.bh_blocked_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32]
         lib.bh_blocked_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, u8p]
+        lib.bh_pack.argtypes = [u8p, i32p, ctypes.c_int64, ctypes.c_int32, u8p]
         _lib = lib
         HAS_NATIVE = True
         return lib
@@ -169,5 +170,21 @@ def hash_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int,
         _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
         _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(m), k,
         ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint8),
+    )
+    return out
+
+
+def pack_joined(joined: bytes, lens: np.ndarray, key_len: int) -> np.ndarray:
+    """Scatter a concatenated key buffer into a zero-padded
+    ``uint8[B, key_len]`` matrix (the C++ ingest hot loop)."""
+    lib = _load()
+    assert lib is not None
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    B = lens.shape[0]
+    out = np.zeros((B, key_len), dtype=np.uint8)
+    src = np.frombuffer(joined, dtype=np.uint8)
+    lib.bh_pack(
+        _ptr(src, ctypes.c_uint8), _ptr(lens, ctypes.c_int32), B, key_len,
+        _ptr(out, ctypes.c_uint8),
     )
     return out

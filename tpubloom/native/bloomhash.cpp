@@ -259,4 +259,19 @@ void bh_blocked_query(const uint32_t* words, const uint8_t* keys,
   }
 }
 
+// Host key packing: scatter a concatenated key buffer into the padded
+// uint8[B, L] matrix the device kernels consume (out must be pre-zeroed).
+// This is the framework's C++ ingest hot loop (SURVEY.md §7: "hash on
+// host in C++ and ship only ... per key" — here we ship packed bytes);
+// the pure-Python per-key loop in utils/packing.py is ~10x slower.
+void bh_pack(const uint8_t* joined, const int32_t* lens, int64_t B,
+             int32_t L, uint8_t* out) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < B; i++) {
+    const int32_t len = lens[i];
+    __builtin_memcpy(out + i * L, joined + off, (size_t)len);
+    off += len;
+  }
+}
+
 }  // extern "C"

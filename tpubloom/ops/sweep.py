@@ -130,7 +130,7 @@ def sweep_applicable(
         return False
     R, kmax = choose_params(n_blocks, batch)
     P = max(1, n_blocks // R)
-    if n_blocks % R != 0:
+    if n_blocks % R != 0 or R % 32 != 0:
         return False
     # kmax covers lambda + 8 sigma by construction unless the 1024 cap
     # binds (tiny filter / huge batch), where the chunk loop would
@@ -256,11 +256,29 @@ def _kernel(
         rep = jnp.concatenate([m] * 32, axis=1)  # [KMAX, W*32]
         bits = (rep >> (col512 // W).astype(jnp.uint32)) & _u32(1)
         bitsf = bits.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
-        # same-row indicator: oh rows are one-hot (or zero), so the
-        # R-contraction is exactly 1 for same-row pairs, 0 otherwise
-        same = lax.dot_general(
-            oh, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ).astype(jnp.bfloat16)  # [KMAX, KMAX]
+        # same-row indicator via the Kronecker split of the one-hot:
+        # r = 32*hi + lo, so oh = oh_hi (x) oh_lo and
+        # same = (oh_hi oh_hi^T) * (oh_lo oh_lo^T) elementwise — two
+        # contractions of depth R/32 + 32 instead of one of depth R
+        # (~10x less MXU work for the kernel's biggest matmul). Exact:
+        # all operands 0/1; out-of-range rows miss the hi match.
+        rl_hi = rl // 32
+        rl_lo = rl - rl_hi * 32
+        ohh = jnp.where(
+            rl_hi == lax.broadcasted_iota(jnp.int32, (KMAX, R // 32), 1),
+            jnp.float32(1), jnp.float32(0),
+        ).astype(jnp.bfloat16)
+        ohl = jnp.where(
+            rl_lo == lax.broadcasted_iota(jnp.int32, (KMAX, 32), 1),
+            jnp.float32(1), jnp.float32(0),
+        ).astype(jnp.bfloat16)
+        same_hi = lax.dot_general(
+            ohh, ohh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        same_lo = lax.dot_general(
+            ohl, ohl, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        same = (same_hi * same_lo).astype(jnp.bfloat16)  # [KMAX, KMAX]
         cnts = lax.dot_general(
             same, bitsf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -743,7 +761,9 @@ def apply_blocked_updates(
     B = blk.shape[0]
     k = bit.shape[-1]
     R, KMAX = choose_params(nb, B)
-    if nb % R != 0 or w + 2 > 128:
+    if nb % R != 0 or w + 2 > 128 or R % 32 != 0:
+        # R must be a multiple of 32 for the Kronecker one-hot split
+        # (rows beyond 32*(R//32) would silently drop their inserts)
         raise ValueError(
             f"sweep insert does not support this shape (n_blocks={nb}, "
             f"R={R}, words_per_block={w}) — use insert_path='scatter'"
@@ -782,10 +802,11 @@ def make_sweep_insert_fn(
     def insert(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
         R, KMAX = choose_params(nb, B)
-        if nb % R != 0 or w + 2 > 128:
+        if nb % R != 0 or w + 2 > 128 or R % 32 != 0:
             # partitions must tile the array exactly (or trailing blocks
-            # would silently never receive updates), and the 128-lane
-            # update row must fit block id + W mask words + key idx
+            # would silently never receive updates), the 128-lane update
+            # row must fit block id + W mask words + key idx, and R must
+            # be a multiple of 32 for the Kronecker one-hot split
             raise ValueError(
                 f"sweep insert does not support this shape (n_blocks={nb}, "
                 f"R={R}, words_per_block={w}) — use insert_path='scatter'"
@@ -850,12 +871,16 @@ def make_sweep_insert_fn(
             R=R, KMAX=KMAX, interpret=interp, with_presence=True,
         )
         v = pres_packed.reshape(P, 8, KMAX // 8).transpose(0, 2, 1).reshape(-1)
-        slot_idx = jnp.where(
-            v == 0, jnp.int32(0x7FFFFFFF), (v & _u32(0x7FFFFFFF)).astype(jnp.int32) - 1
+        # single-column unsort: key = (idx+1) << 1 | hit sorts by original
+        # index with the verdict riding the LSB; filler slots (v == 0) map
+        # to the max key and sink to the tail
+        vkey = jnp.where(
+            v == 0,
+            _u32(0xFFFFFFFE),  # even: filler slots must read as hit=0
+            ((v & _u32(0x7FFFFFFF)) << _u32(1)) | (v >> _u32(31)),
         )
-        slot_hit = (v >> _u32(31)).astype(jnp.uint32)
-        sidx, shit = lax.sort((slot_idx, slot_hit), num_keys=1)
-        fused = shit[:B] == 1
+        (skey,) = lax.sort((vkey,), num_keys=1)
+        fused = (skey[:B] & _u32(1)) == 1
         present = jnp.where(overflow, presence_fb, fused)
         return new_blocks, present
 

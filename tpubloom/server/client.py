@@ -204,15 +204,17 @@ class BloomClient:
         # never auto-retried: a replay after an insert that DID land
         # would report the batch's own keys as pre-existing duplicates
         resp = self._rpc("InsertBatch", req, force_no_retry=True)
+        return self._unpack_bool(resp, "presence")
+
+    @staticmethod
+    def _unpack_bool(resp: dict, field: str) -> np.ndarray:
         return np.unpackbits(
-            np.frombuffer(resp["presence"], np.uint8), count=resp["n"]
+            np.frombuffer(resp[field], np.uint8), count=resp["n"]
         ).astype(bool)
 
     def include_batch(self, name: str, keys: Sequence[bytes | str]) -> np.ndarray:
         resp = self._rpc("QueryBatch", {"name": name, "keys": self._keys(keys)})
-        return np.unpackbits(
-            np.frombuffer(resp["hits"], np.uint8), count=resp["n"]
-        ).astype(bool)
+        return self._unpack_bool(resp, "hits")
 
     def delete_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
         return self._rpc("DeleteBatch", {"name": name, "keys": self._keys(keys)})["n"]

@@ -193,12 +193,17 @@ class BloomService:
             presence = None
             if want_presence:
                 # fused test-and-insert (blocked filters run it as one
-                # device pass; others fall back to query-then-insert)
-                try:
+                # device pass; others fall back to query-then-insert).
+                # Capability is probed via the signature — catching
+                # TypeError would also swallow genuine kernel bugs.
+                import inspect
+
+                sig = inspect.signature(mf.filter.insert_batch)
+                if "return_presence" in sig.parameters:
                     presence = mf.filter.insert_batch(
                         req["keys"], return_presence=True
                     )
-                except TypeError:
+                else:
                     presence = mf.filter.include_batch(req["keys"])
                     mf.filter.insert_batch(req["keys"])
             else:

@@ -44,6 +44,18 @@ def pack_keys(
     if key_policy == "digest" and key_len < 16:
         raise ValueError("key_policy='digest' requires key_len >= 16")
     B = len(keys)
+    # C++ fast path for the common case (all-bytes keys within key_len):
+    # one join + one native scatter instead of a per-key Python loop —
+    # this is the host ingest hot loop (SURVEY.md §7 native key packing)
+    if B and all(type(k) is bytes for k in keys):
+        from tpubloom import native
+
+        if native.available():
+            lens = np.fromiter(
+                (len(k) for k in keys), dtype=np.int32, count=B
+            )
+            if int(lens.max()) <= key_len:
+                return native.pack_joined(b"".join(keys), lens, key_len), lens
     out = np.zeros((B, key_len), dtype=np.uint8)
     lens = np.zeros((B,), dtype=np.int32)
     for i, key in enumerate(keys):

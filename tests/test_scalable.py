@@ -102,3 +102,26 @@ def test_invalid_params_rejected():
         ScalableBloomFilter(100, 0.01, growth=1)
     with pytest.raises(ValueError):
         ScalableBloomFilter(100, 0.01, tightening=1.0)
+
+
+def test_blocked_layers_parity():
+    """A blocked base config builds blocked layers on both variants and
+    keeps them bit-interchangeable through growth."""
+    import numpy as np
+
+    from tpubloom import CPUScalableBloomFilter, FilterConfig, ScalableBloomFilter
+    from tpubloom.filter import BlockedBloomFilter
+
+    base = FilterConfig(m=512, k=1, key_len=16, block_bits=512)
+    f = ScalableBloomFilter(500, 0.01, config=base)
+    o = CPUScalableBloomFilter(500, 0.01, config=base, use_native=False)
+    assert isinstance(f.layers[0], BlockedBloomFilter)
+    rng = np.random.default_rng(5)
+    keys = [rng.bytes(16) for _ in range(3000)]  # several growth steps
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    assert len(f.layers) == len(o.layers) > 1
+    for df, dc in zip(f.layers, o.layers):
+        np.testing.assert_array_equal(np.asarray(df.words), dc.words)
+    probe = keys[:200] + [rng.bytes(16) for _ in range(800)]
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))

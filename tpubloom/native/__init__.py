@@ -21,6 +21,7 @@ _LIB_PATH = os.path.join(_HERE, "libbloomhash.so")
 
 _lock = threading.Lock()
 _lib = None
+_load_failed = False  # negative cache: never re-fork a failing compiler
 HAS_NATIVE = False
 
 
@@ -37,16 +38,20 @@ def _build() -> bool:
 
 
 def _load():
-    global _lib, HAS_NATIVE
+    global _lib, HAS_NATIVE, _load_failed
     with _lock:
         if _lib is not None:
             return _lib
+        if _load_failed:
+            return None
         if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
             if not _build():
+                _load_failed = True
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
+            _load_failed = True
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -181,6 +186,17 @@ def pack_joined(joined: bytes, lens: np.ndarray, key_len: int) -> np.ndarray:
     assert lib is not None
     lens = np.ascontiguousarray(lens, dtype=np.int32)
     B = lens.shape[0]
+    if B:
+        if int(lens.min()) < 0 or int(lens.max()) > key_len:
+            raise ValueError(
+                f"lens must be in [0, key_len={key_len}]; "
+                f"got [{int(lens.min())}, {int(lens.max())}]"
+            )
+        if int(lens.sum()) != len(joined):
+            raise ValueError(
+                f"joined buffer is {len(joined)} bytes but lens sum to "
+                f"{int(lens.sum())}"
+            )
     out = np.zeros((B, key_len), dtype=np.uint8)
     src = np.frombuffer(joined, dtype=np.uint8)
     lib.bh_pack(

@@ -60,6 +60,10 @@ def layer_config(
     p_i = error_rate * (tightening ** layer)
     m, k = optimal_m_k(cap_i, p_i)
     m = round_up_pow2(m)
+    if base.block_bits:
+        # blocked layers need at least one whole block (more bits only
+        # tightens the layer under its error budget)
+        m = max(m, base.block_bits)
     seed_i = (base.seed + layer * LAYER_SEED_STRIDE) % (1 << 32)
     return base.replace(m=m, k=k, seed=seed_i, shards=1), cap_i
 
@@ -179,7 +183,13 @@ class _ScalableCore:
 
 
 class ScalableBloomFilter(_ScalableCore):
-    """Device-resident scalable filter: a stack of TPU BloomFilter layers."""
+    """Device-resident scalable filter: a stack of TPU filter layers.
+
+    A base ``config`` with ``block_bits`` set builds BLOCKED layers —
+    every layer then runs the blocked hot path (the Pallas sweep on TPU
+    once a layer is large enough); flat configs keep the
+    reference-compatible position spec per layer.
+    """
 
     def __init__(
         self,
@@ -190,11 +200,12 @@ class ScalableBloomFilter(_ScalableCore):
         growth: int = 2,
         tightening: float = 0.5,
     ):
-        from tpubloom.filter import BloomFilter
+        from tpubloom.filter import BlockedBloomFilter, BloomFilter
 
         base = config if config is not None else FilterConfig(m=64, k=1)
+        factory = BlockedBloomFilter if base.block_bits else BloomFilter
         super().__init__(
-            BloomFilter, base, capacity, error_rate,
+            factory, base, capacity, error_rate,
             growth=growth, tightening=tightening,
         )
 
@@ -226,12 +237,13 @@ class CPUScalableBloomFilter(_ScalableCore):
         tightening: float = 0.5,
         use_native: bool | None = None,
     ):
-        from tpubloom.cpu_ref import CPUBloomFilter
+        from tpubloom.cpu_ref import CPUBlockedBloomFilter, CPUBloomFilter
 
         base = config if config is not None else FilterConfig(m=64, k=1)
+        cpu_factory = CPUBlockedBloomFilter if base.block_bits else CPUBloomFilter
 
         def make_layer(cfg: FilterConfig):
-            return CPUBloomFilter(cfg, use_native=use_native)
+            return cpu_factory(cfg, use_native=use_native)
 
         super().__init__(
             make_layer, base, capacity, error_rate,

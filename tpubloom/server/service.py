@@ -194,12 +194,17 @@ class BloomService:
             if want_presence:
                 # fused test-and-insert (blocked filters run it as one
                 # device pass; others fall back to query-then-insert).
-                # Capability is probed via the signature — catching
-                # TypeError would also swallow genuine kernel bugs.
-                import inspect
+                # Capability is probed once per filter via the signature —
+                # catching TypeError would also swallow genuine kernel bugs.
+                cached = getattr(mf, "supports_presence", None)
+                if cached is None:
+                    import inspect
 
-                sig = inspect.signature(mf.filter.insert_batch)
-                if "return_presence" in sig.parameters:
+                    cached = "return_presence" in inspect.signature(
+                        mf.filter.insert_batch
+                    ).parameters
+                    mf.supports_presence = cached
+                if cached:
                     presence = mf.filter.insert_batch(
                         req["keys"], return_presence=True
                     )

@@ -43,8 +43,15 @@ log = logging.getLogger("tpubloom.server")
 
 class _Managed:
     def __init__(self, filt, sink, checkpoint_every: int):
+        import inspect
+
         self.filter = filt
         self.lock = threading.Lock()
+        # fused test-and-insert capability is a static property of the
+        # filter class — probe once, not per InsertBatch request
+        self.supports_presence = (
+            "return_presence" in inspect.signature(filt.insert_batch).parameters
+        )
         self.checkpointer = (
             ckpt.AsyncCheckpointer(filt, sink, every_n_inserts=checkpoint_every)
             if sink is not None
@@ -193,18 +200,8 @@ class BloomService:
             presence = None
             if want_presence:
                 # fused test-and-insert (blocked filters run it as one
-                # device pass; others fall back to query-then-insert).
-                # Capability is probed once per filter via the signature —
-                # catching TypeError would also swallow genuine kernel bugs.
-                cached = getattr(mf, "supports_presence", None)
-                if cached is None:
-                    import inspect
-
-                    cached = "return_presence" in inspect.signature(
-                        mf.filter.insert_batch
-                    ).parameters
-                    mf.supports_presence = cached
-                if cached:
+                # device pass; others fall back to query-then-insert)
+                if mf.supports_presence:
                     presence = mf.filter.insert_batch(
                         req["keys"], return_presence=True
                     )

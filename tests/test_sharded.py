@@ -215,3 +215,21 @@ def test_blocked_checkpoint_restore(blk_cfg8, tmp_path):
     g = ckpt.restore(cfg, sink)
     assert isinstance(g, ShardedBloomFilter)
     assert g.include_batch(keys).all()
+
+
+def test_blocked_sweep_path_in_shard_map():
+    """Forced sweep (Pallas interpret mode inside shard_map on the fake
+    8-device mesh) matches the scatter path bit for bit — guards the
+    per-device sweep hot loop that runs on real TPUs."""
+    cfg = FilterConfig(
+        m=1 << 25, k=5, key_len=16, block_bits=512, shards=8,
+        insert_path="sweep",
+    )
+    f = ShardedBloomFilter(cfg, mesh=make_mesh(8))
+    rng = np.random.default_rng(9)
+    keys = [rng.bytes(16) for _ in range(512)]
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
+    g.insert_batch(keys)
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))

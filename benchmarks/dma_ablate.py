@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""DMA-floor ablation: is the 2.7us/partition stream fetch bandwidth-bound
+or per-DMA-overhead-bound? (follow-up to kernel_ablate.py variant A)
+
+  A0    no update DMA at all (grid + blocks auto-pipeline only)
+  A48   fetch 48 rows/partition  (24KB)
+  A96   fetch 96 rows/partition  (48KB)
+  A384  fetch 384 rows/partition (196KB; = variant A)
+  A384x2 same bytes in TWO parallel DMAs on separate sems
+
+Timings only (results are wrong on purpose for the small windows).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops.sweep import _ALIGN, choose_params
+
+LOG2M = 32
+B = 1 << 22
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=16, block_bits=512)
+NB, W = config.n_blocks, config.words_per_block
+R, KMAX = choose_params(NB, B)
+P = NB // R
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _kernel(
+    starts_ref, upd_ref, blocks_ref, out_ref, sup_ref, sems,
+    *, FETCH, NSPLIT,
+):
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+    off0 = (starts_ref[p] // _ALIGN) * _ALIGN
+
+    def fetch(slot, off):
+        if NSPLIT == 1:
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(off, FETCH), :],
+                sup_ref.at[slot, pl.ds(0, FETCH)],
+                sems.at[slot, 0],
+            ).start()
+        else:
+            step = FETCH // NSPLIT
+            for i in range(NSPLIT):
+                pltpu.make_async_copy(
+                    upd_ref.at[pl.ds(off + i * step, step), :],
+                    sup_ref.at[slot, pl.ds(i * step, step)],
+                    sems.at[slot, i],
+                ).start()
+
+    def wait(slot):
+        if NSPLIT == 1:
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(0, FETCH), :],
+                sup_ref.at[slot, pl.ds(0, FETCH)],
+                sems.at[slot, 0],
+            ).wait()
+        else:
+            step = FETCH // NSPLIT
+            for i in range(NSPLIT):
+                pltpu.make_async_copy(
+                    upd_ref.at[pl.ds(0, step), :],
+                    sup_ref.at[slot, pl.ds(0, step)],
+                    sems.at[slot, i],
+                ).wait()
+
+    if FETCH:
+        slot = lax.rem(p, 2)
+
+        @pl.when(p == 0)
+        def _():
+            fetch(0, off0)
+
+        @pl.when(p + 1 < num_p)
+        def _():
+            fetch(1 - slot, (starts_ref[p + 1] // _ALIGN) * _ALIGN)
+
+        wait(slot)
+        # REALLY consume the fetched window (no *0 — Mosaic must not be
+        # able to fold the use away and DCE the DMAs): OR one real row of
+        # the buffer into the tile. Results are wrong; traffic is right.
+        row = sup_ref[slot][0:1, 1 : W + 1]
+        out_ref[:] = blocks_ref[:] | row
+    else:
+        out_ref[:] = blocks_ref[:] | _u32(starts_ref[p])
+
+
+def run(name, FETCH, NSPLIT=1):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, max(FETCH, 8), 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, max(NSPLIT, 1))),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_kernel, FETCH=FETCH, NSPLIT=NSPLIT),
+        out_shape=jax.ShapeDtypeStruct((NB, W), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+    )
+    starts, upd = _DATA
+
+    def step(state, upd, starts):
+        out = fn(starts, upd, state)
+        return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "variant": name,
+                "fetch_rows": FETCH,
+                "nsplit": NSPLIT,
+                "ms": round(dt * 1e3, 3),
+                "us_per_partition": round(dt / P * 1e6, 3),
+                "eff_GBps": round(
+                    (FETCH * 128 * 4 * P) / dt / 1e9, 1
+                ) if FETCH else None,
+            }
+        ),
+        flush=True,
+    )
+
+
+_DATA = None
+
+
+def _build_real_stream():
+    """The real sorted update stream from kernel_ablate (block-sorted ids
+    + masks), so fetch offsets/values match production."""
+    from benchmarks.kernel_ablate import build_stream
+
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(rng.integers(0, 256, (B, 16), np.uint8))
+    starts, upd = jax.jit(build_stream)(keys)
+    starts.block_until_ready()
+    return starts, upd
+
+
+def main():
+    global _DATA
+    print(json.dumps({"R": R, "KMAX": KMAX, "P": P}), flush=True)
+    _DATA = _build_real_stream()
+    run("A0 no update DMA", 0)
+    run("A48", 48)
+    run("A96", 96)
+    run("A384", 384)
+    run("A384 split2", 384, 2)
+    run("A384 split4", 384, 4)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Grid-step floor scaling: is the 2.45us/step cost fixed per step (then
+bigger tiles amortize it) or blocks-bandwidth (then it scales with R)?
+
+Runs the A0 kernel (no update DMA; out = blocks | scalar) at tile sizes
+R in {512, 1024, 2048, 4096}, plus a no-aliasing variant at R=512.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubloom.config import FilterConfig
+
+LOG2M = 32
+STEPS = 8
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=16, block_bits=512)
+NB, W = config.n_blocks, config.words_per_block
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _kernel(starts_ref, blocks_ref, out_ref):
+    p = pl.program_id(0)
+    out_ref[:] = blocks_ref[:] | _u32(starts_ref[p])
+
+
+def run(R, alias=True):
+    P = NB // R
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[pl.BlockSpec((R, W), lambda p, *_: (p, 0))],
+        out_specs=pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+    )
+    fn = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((NB, W), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={1: 0} if alias else {},
+    )
+    starts = jnp.zeros((P + 1,), jnp.int32)
+
+    def step(state, starts):
+        out = fn(starts, state)
+        return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    state, carry = jit(state, starts)
+    carry.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "R": R, "P": P, "alias": alias,
+                "ms": round(dt * 1e3, 3),
+                "us_per_step": round(dt / P * 1e6, 3),
+                "eff_GBps_inout": round(2 * NB * W * 4 / dt / 1e9, 1),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main():
+    for R in (512, 1024, 2048, 4096, 8192):
+        run(R)
+    run(512, alias=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""In-kernel stage ablation of the partition sweep (VERDICT r1 task 1).
+
+The R-sweep showed the kernel is per-partition-overhead-bound (per-step
+time has a ~2us floor; MXU math alone predicts ~1us/step at R=512), so
+this measures cumulative kernel variants at the north-star shape to
+locate the microseconds:
+
+  A stream-only     out = blocks | broadcast(buf row)  (grid + both DMAs)
+  B +onehot+bits    one-hot row match + bit-plane expansion, trivial use
+  C merge-free      delta_cnt = oh^T @ bits -> >0 -> pack matmuls (no
+                    same-row merge machinery at all)
+  D current         the shipping kernel (same/cnts/first merge)
+
+C is also a candidate replacement: fewer stages, no [KMAX,KMAX] block.
+Run: PYTHONPATH=... timeout 900 python benchmarks/kernel_ablate.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _ALIGN,
+    _pack_positions,
+    _stream_scaffold,
+    _unpack_positions,
+    choose_params,
+    sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+R, KMAX = choose_params(NB, B)
+P = NB // R
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _ablate_kernel(
+    starts_ref, upd_ref, blocks_ref, out_ref, sup_ref, sems,
+    *, R, KMAX, W, LEVEL,
+):
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+    s0 = starts_ref[p]
+    off0 = (s0 // _ALIGN) * _ALIGN
+
+    def fetch(slot, off):
+        cp = pltpu.make_async_copy(
+            upd_ref.at[pl.ds(off, KMAX), :], sup_ref.at[slot], sems.at[slot]
+        )
+        cp.start()
+        return cp
+
+    def wait(slot):
+        pltpu.make_async_copy(
+            upd_ref.at[pl.ds(0, KMAX), :], sup_ref.at[slot], sems.at[slot]
+        ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, off0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, (starts_ref[p + 1] // _ALIGN) * _ALIGN)
+
+    wait(slot)
+    buf = sup_ref[slot]  # [KMAX, 128] u32
+
+    if LEVEL == "A":
+        # consume the buffer without real compute: broadcast-OR one row's
+        # mask words into the tile (wrong results, right memory traffic)
+        row = buf[0:1, 1 : W + 1]  # [1, W]
+        out_ref[:] = blocks_ref[:] | (row * _u32(0))  # keep DMA live, no-op OR
+        return
+
+    base = jnp.uint32(p * R)
+    rl = (buf[:, 0:1] - base).astype(jnp.int32)
+    colsR = lax.broadcasted_iota(jnp.int32, (KMAX, R), 1)
+    ohf = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+    oh = ohf.astype(jnp.bfloat16)
+    m = buf[:, 1 : W + 1]
+    col512 = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
+    rep = jnp.concatenate([m] * 32, axis=1)
+    bits = (rep >> (col512 // W).astype(jnp.uint32)) & _u32(1)
+    bitsf = bits.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+
+    if LEVEL == "B":
+        # use oh + bits trivially: one matmul column-sum to keep both live
+        colsum = lax.dot_general(
+            oh, bitsf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, 512]
+        cheap = jnp.min(colsum, axis=1, keepdims=True)  # [R, 1]
+        out_ref[:] = blocks_ref[:] | (
+            cheap.astype(jnp.int32).astype(jnp.uint32) * _u32(0)
+        )
+        return
+
+    # LEVEL == "C": merge-free delta — oh^T @ bits counts per (row, plane),
+    # plane > 0 -> bit set; pack planes to words via exact matmuls.
+    cnt = lax.dot_general(
+        oh, bitsf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [R, W*32] exact counts (f32 acc of 0/1 products)
+    present = jnp.where(cnt > 0, jnp.float32(1), jnp.float32(0)).astype(
+        jnp.bfloat16
+    )
+    ccol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 0)
+    hcol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 1)
+    b_of_c = ccol // W
+    w_of_c = lax.rem(ccol, W)
+    pack_w = jnp.where(
+        (w_of_c + (b_of_c // 8) * W) == hcol,
+        (1 << lax.rem(b_of_c, 8)).astype(jnp.float32),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    quarters = lax.dot_general(
+        present, pack_w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [R, 4W] 8-bit quarters
+    qcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 0)
+    wcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 1)
+    q_of = qcol // W
+    w_of = lax.rem(qcol, W)
+    comb_lo = jnp.where(
+        (w_of == wcol) & (q_of < 2),
+        jnp.where(q_of == 0, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    comb_hi = jnp.where(
+        (w_of == wcol) & (q_of >= 2),
+        jnp.where(q_of == 2, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    qb = quarters.astype(jnp.bfloat16)
+    lo = lax.dot_general(
+        qb, comb_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    hi = lax.dot_general(
+        qb, comb_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << _u32(16)
+    )
+    out_ref[:] = blocks_ref[:] | delta
+
+
+def run_variant(level, starts, upd):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((R, W), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, KMAX, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_ablate_kernel, R=R, KMAX=KMAX, W=W, LEVEL=level),
+        out_shape=jax.ShapeDtypeStruct((NB, W), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+    )
+
+    def step(state, upd, starts):
+        out = fn(starts, upd, state)
+        return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "variant": level,
+                "ms": round(dt * 1e3, 3),
+                "us_per_partition": round(dt / P * 1e6, 3),
+                "keys_per_sec": round(B / dt),
+                "compile_s": round(compile_s, 1),
+            }
+        ),
+        flush=True,
+    )
+    return state
+
+
+def build_stream(keys):
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+    )
+    blk = blk.astype(jnp.uint32)
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+    bs = sorted_cols[0].astype(jnp.int32)
+    bit_sorted = _unpack_positions(sorted_cols[1:], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    starts, upd = _stream_scaffold(bs, NB, P, R, KMAX)
+    upd = upd.at[:B, 1 : W + 1].set(masks)
+    return starts, upd
+
+
+def main():
+    print(json.dumps({"R": R, "KMAX": KMAX, "P": P, "B": B}), flush=True)
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(rng.integers(0, 256, (B, KEY_LEN), np.uint8))
+    starts, upd = jax.jit(build_stream)(keys)
+    starts.block_until_ready()
+    for level in ("A", "B", "C"):
+        run_variant(level, starts, upd)
+
+    # D: the shipping kernel (no presence), same stream
+    def step(state, upd, starts):
+        out = sweep_insert(
+            state, upd, starts, R=R, KMAX=KMAX, interpret=False,
+            with_presence=False,
+        )
+        return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "variant": "D (shipping kernel)",
+                "ms": round(dt * 1e3, 3),
+                "us_per_partition": round(dt / P * 1e6, 3),
+                "keys_per_sec": round(B / dt),
+            }
+        ),
+        flush=True,
+    )
+
+    # C correctness cross-check vs D on the same stream
+    state_c = run_variant("C", starts, upd)
+    ok = bool(
+        jnp.array_equal(
+            state_c[:: NB // 4096], state[:: NB // 4096]
+        )
+    )
+    print(json.dumps({"C_vs_D_sampled_equal": ok}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

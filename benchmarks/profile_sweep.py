@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Per-stage breakdown of the fused sweep step (VERDICT r1 task 1).
+
+Measures cumulative prefixes of the fused test-and-insert pipeline at the
+north-star shape (m=2^32, k=7, B=4M, blocked512) with honest chained
+timing (carry-fed seeds + block_until_ready once per loop; see
+.claude/skills/verify/SKILL.md benchmarking rules), then reports
+per-stage deltas. Stages:
+
+  P0 keygen       device RNG [B, 16] u8
+  P1 +hash        block_positions (3x murmur/fnv over 16B keys)
+  P2 +sort        pack positions + 4-column lax.sort (blk, lo, hi, idx)
+  P3 +masks       unpack + build_masks [B, W]
+  P4 +stream      searchsorted + [B+pad, 128] u32 update buffer build
+  P5 +kernel      sweep_insert with_presence (the Pallas grid sweep)
+  P6 full         + presence unsort + overflow cond (make_sweep_insert_fn)
+
+Also measured: kernel-only (pre-built stream, re-applied each step) to
+split stream-build cost from in-kernel DMA+MXU cost.
+
+Prints one JSON line per measurement; run via
+  timeout 900 python benchmarks/profile_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _pack_positions,
+    _stream_scaffold,
+    _unpack_positions,
+    choose_params,
+    make_sweep_insert_fn,
+    sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+R, KMAX = choose_params(NB, B)
+P = NB // R
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def keygen(seed_carry, i):
+    return jax.random.bits(
+        jax.random.key(i ^ (seed_carry & 0xFFFF)), (B, KEY_LEN), jnp.uint8
+    )
+
+
+def p0(state, carry, i):
+    keys = keygen(carry, i)
+    return state, jnp.sum(keys.astype(jnp.uint32))
+
+
+def p1(state, carry, i):
+    keys = keygen(carry, i)
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+    )
+    return state, jnp.sum(blk.astype(jnp.uint32)) + jnp.sum(bit)
+
+
+def _sorted_cols(keys):
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+    )
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+    sorted_cols = lax.sort((blk.astype(jnp.uint32),) + cols + (idx0,), num_keys=1)
+    return sorted_cols, nbits, packed
+
+
+def p2(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, _, _ = _sorted_cols(keys)
+    return state, sum(jnp.sum(c) for c in sorted_cols)
+
+
+def p3(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return state, jnp.sum(masks) + jnp.sum(sorted_cols[0])
+
+
+def _stream(keys):
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    bs = sorted_cols[0].astype(jnp.int32)
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    starts, upd = _stream_scaffold(bs, NB, P, R, KMAX)
+    upd = upd.at[:B, 1 : W + 1].set(masks)
+    upd = upd.at[:B, W + 1].set(sorted_cols[-1])
+    return starts, upd
+
+
+def p4(state, carry, i):
+    keys = keygen(carry, i)
+    starts, upd = _stream(keys)
+    return state, jnp.sum(upd, dtype=jnp.uint32)[()] + jnp.sum(starts).astype(
+        jnp.uint32
+    )
+
+
+def p5(state, carry, i):
+    keys = keygen(carry, i)
+    starts, upd = _stream(keys)
+    new_blocks, pres = sweep_insert(
+        state, upd, starts, R=R, KMAX=KMAX, interpret=False, with_presence=True
+    )
+    return new_blocks, jnp.sum(pres, dtype=jnp.uint32)
+
+
+_full_fn = make_sweep_insert_fn(config, interpret=False, with_presence=True)
+
+
+def p6(state, carry, i):
+    keys = keygen(carry, i)
+    new_blocks, present = _full_fn(state, keys, lengths)
+    return new_blocks, jnp.sum(present.astype(jnp.uint32))
+
+
+def run(name, step, donate=True, steps=STEPS):
+    state0 = jnp.zeros((NB, W), jnp.uint32)
+    jit = jax.jit(step, donate_argnums=(0,) if donate else ())
+    t0 = time.perf_counter()
+    state, carry = jit(state0, _u32(0), 0)
+    carry.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    state, carry = jit(state, carry, 1)  # warm
+    t0 = time.perf_counter()
+    for i in range(2, 2 + steps):
+        state, carry = jit(state, carry, i)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    out = {
+        "stage": name,
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+        "compile_s": round(compile_s, 1),
+    }
+    print(json.dumps(out), flush=True)
+    del state, carry
+    return dt
+
+
+def kernel_only():
+    """Sweep kernel on a pre-built stream: isolates DMA + MXU from the
+    stream build. Chained via the donated blocks state; the stream is
+    rebuilt-free (same updates re-applied — ORs are idempotent, counts
+    of work identical)."""
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    starts, upd = jax.jit(_stream)(keys)
+    starts.block_until_ready()
+
+    def step(state, upd, starts):
+        new_blocks, pres = sweep_insert(
+            state, upd, starts, R=R, KMAX=KMAX, interpret=False, with_presence=True
+        )
+        return new_blocks, jnp.sum(pres, dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "stage": "kernel_only(prebuilt stream, with_presence)",
+                "ms_per_step": round(dt * 1e3, 3),
+                "ns_per_key": round(dt / B * 1e9, 3),
+                "compile_s": round(compile_s, 1),
+            }
+        ),
+        flush=True,
+    )
+    # and without presence (pure insert sweep)
+    def step2(state, upd, starts):
+        nb = sweep_insert(
+            state, upd, starts, R=R, KMAX=KMAX, interpret=False, with_presence=False
+        )
+        return nb, jnp.sum(nb[:: NB // 64], dtype=jnp.uint32)
+
+    jit2 = jax.jit(step2, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    state, carry = jit2(state, upd, starts)
+    carry.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit2(state, upd, starts)
+    carry.block_until_ready()
+    dt2 = (time.perf_counter() - t0) / STEPS
+    print(
+        json.dumps(
+            {
+                "stage": "kernel_only(prebuilt stream, insert only)",
+                "ms_per_step": round(dt2 * 1e3, 3),
+                "ns_per_key": round(dt2 / B * 1e9, 3),
+            }
+        ),
+        flush=True,
+    )
+
+
+def sort_scaling():
+    """lax.sort cost vs payload column count at B=4M."""
+    rng = jax.random.key(7)
+    cols = [jax.random.bits(jax.random.fold_in(rng, i), (B,), jnp.uint32)
+            for i in range(5)]
+
+    for nc in (1, 2, 4, 5):
+        def step(carry, i, nc=nc):
+            key0 = cols[0] ^ carry
+            out = lax.sort(tuple([key0] + cols[1:nc]), num_keys=1)
+            return sum(jnp.sum(c) for c in out).astype(jnp.uint32)
+
+        jit = jax.jit(step)
+        carry = jit(_u32(0), 0)
+        carry.block_until_ready()
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            carry = jit(carry, i)
+        carry.block_until_ready()
+        dt = (time.perf_counter() - t0) / STEPS
+        print(
+            json.dumps(
+                {
+                    "stage": f"lax.sort {nc} u32 cols, B=4M",
+                    "ms_per_step": round(dt * 1e3, 3),
+                    "ns_per_key": round(dt / B * 1e9, 3),
+                }
+            ),
+            flush=True,
+        )
+
+
+def main():
+    print(
+        json.dumps(
+            {
+                "shape": {
+                    "m": config.m, "k": K, "B": B, "block_bits": BB,
+                    "n_blocks": NB, "W": W, "R": R, "KMAX": KMAX, "P": P,
+                    "platform": jax.default_backend(),
+                    "device": str(jax.devices()[0]),
+                }
+            }
+        ),
+        flush=True,
+    )
+    prev = 0.0
+    deltas = {}
+    for name, fn in [
+        ("P0 keygen", p0),
+        ("P1 +hash", p1),
+        ("P2 +sort", p2),
+        ("P3 +masks", p3),
+        ("P4 +stream", p4),
+        ("P5 +kernel", p5),
+        ("P6 full fused", p6),
+    ]:
+        dt = run(name, fn)
+        deltas[name] = dt - prev
+        prev = dt
+    print(
+        json.dumps(
+            {
+                "deltas_ms": {k: round(v * 1e3, 3) for k, v in deltas.items()},
+                "deltas_ns_per_key": {
+                    k: round(v / B * 1e9, 3) for k, v in deltas.items()
+                },
+            }
+        ),
+        flush=True,
+    )
+    kernel_only()
+    sort_scaling()
+
+
+if __name__ == "__main__":
+    main()

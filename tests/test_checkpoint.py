@@ -241,3 +241,87 @@ def test_async_checkpointer_skips_when_busy(cfg, tmp_path):
     cp.flush()
     cp.close(final_checkpoint=False)
     assert cp.checkpoints_written == 1
+
+
+# -- scalable (layer-stack) checkpoints --------------------------------------
+
+
+def _scalable_with_growth(tmp_path, *, block_bits=0):
+    """A scalable filter pushed across >= 1 growth boundary + its keys."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    base = FilterConfig(
+        m=max(64, block_bits), k=1, key_len=16, key_name="scale-ckpt",
+        block_bits=block_bits,
+    )
+    f = ScalableBloomFilter(300, 0.01, config=base)
+    rng = np.random.default_rng(7)
+    keys = _rand_keys(1000, rng)  # 300-capacity base layer -> >= 2 layers
+    f.insert_batch(keys)
+    assert f.n_layers >= 2, "test must cross a growth boundary"
+    return f, base, keys
+
+
+@pytest.mark.parametrize("block_bits", [0, 512])
+def test_scalable_roundtrip_across_growth(tmp_path, block_bits):
+    """VERDICT r1 task 2 'Done' criterion: insert across a growth boundary
+    -> save -> restore -> identical membership AND identical layer stack."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    f, base, keys = _scalable_with_growth(tmp_path, block_bits=block_bits)
+    sink = ckpt.FileSink(str(tmp_path))
+    seq = ckpt.save(f, sink)
+    g = ckpt.restore(base, sink)
+    assert isinstance(g, ScalableBloomFilter)
+    assert g._restored_seq == seq
+    # identical layer stack: count, per-layer config, per-layer fill, words
+    assert g.n_layers == f.n_layers
+    for la, lb in zip(f.layers, g.layers):
+        assert la.config == lb.config
+        np.testing.assert_array_equal(np.asarray(la.words), np.asarray(lb.words))
+    assert g._layer_counts == f._layer_counts
+    assert g.n_inserted == f.n_inserted
+    # identical membership
+    assert g.include_batch(keys).all()
+    rng = np.random.default_rng(8)
+    probe = _rand_keys(2000, rng)
+    np.testing.assert_array_equal(f.include_batch(probe), g.include_batch(probe))
+
+
+def test_scalable_restore_rejects_policy_mismatch(tmp_path):
+    f, base, _ = _scalable_with_growth(tmp_path)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    with pytest.raises(ValueError, match="policy mismatch on capacity"):
+        ckpt.restore(base, sink, scalable_expect={"capacity": 999})
+    with pytest.raises(ValueError, match="policy mismatch on tightening"):
+        ckpt.restore(base, sink, scalable_expect={"tightening": 0.25})
+
+
+def test_scalable_restore_rejects_base_identity_mismatch(tmp_path):
+    f, base, _ = _scalable_with_growth(tmp_path)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    with pytest.raises(ValueError, match="mismatch on base seed"):
+        ckpt.restore(base.replace(seed=123), sink)
+
+
+def test_scalable_async_checkpointer(tmp_path):
+    """The async path snapshots the whole layer stack consistently and the
+    final checkpoint captures post-growth layers."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    base = FilterConfig(m=64, k=1, key_len=16, key_name="scale-async")
+    f = ScalableBloomFilter(300, 0.01, config=base)
+    sink = ckpt.FileSink(str(tmp_path))
+    cp = ckpt.AsyncCheckpointer(f, sink, every_n_inserts=400)
+    rng = np.random.default_rng(9)
+    keys = _rand_keys(1200, rng)
+    for i in range(0, 1200, 200):
+        f.insert_batch(keys[i : i + 200])
+        cp.notify_inserts(200)
+    assert cp.close(final_checkpoint=True)
+    assert cp.checkpoints_written >= 2 and cp.last_error is None
+    g = ckpt.restore(base, sink)
+    assert g.n_layers == f.n_layers and g.n_inserted == 1200
+    assert g.include_batch(keys).all()

@@ -119,6 +119,12 @@ def test_choose_params_and_applicability():
     assert sweep_applicable(1 << 23, 1 << 20)
     # tiny filters stay on the scatter path
     assert not sweep_applicable(64, 1 << 20)
+    # sparse batches stay on the scatter path too: the sweep streams the
+    # whole array per call, so a scalar insert (padded to 64) into a big
+    # filter must NOT resolve to it (advisor r1, medium)
+    assert not sweep_applicable(1 << 23, 64)
+    assert not sweep_applicable(1 << 23, 1 << 15)  # lambda < 8
+    assert sweep_applicable(1 << 23, 1 << 17)  # lambda = 8, break-even+margin
 
 
 def _run_test_insert(config, keys_u8, lengths, blocks):

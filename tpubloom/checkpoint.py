@@ -41,6 +41,11 @@ from tpubloom.config import FilterConfig, identity_mismatch
 
 MAGIC = b"TPUBLOOM1\n"
 
+#: Base-config identity for scalable checkpoints: the template's m/k are
+#: placeholders (each layer derives its own from the growth policy), so
+#: only the fields every layer inherits participate.
+IDENTITY_FIELDS_SCALABLE = ("seed", "counting", "shards", "block_bits")
+
 _CKPT_RE = re.compile(r"^(?P<name>.+)\.(?P<seq>\d{12,})\.ckpt$")
 
 
@@ -76,6 +81,35 @@ def _serialize(
         }
     ).encode()
     return MAGIC + len(header).to_bytes(8, "little") + header + payload
+
+
+def _serialize_scalable(
+    base_config: FilterConfig,
+    meta: dict,
+    seq: int,
+    layer_words,
+    extra: Optional[dict] = None,
+) -> bytes:
+    """Layer-stack checkpoint: header lists per-layer config + fill count
+    (scalable.snapshot_meta), payload = concatenated per-layer raw LE
+    words. Geometry is re-derived from the growth policy on restore and
+    verified against the stored layer configs."""
+    payloads = [
+        np.asarray(w, dtype=np.uint32).reshape(-1).astype("<u4").tobytes()
+        for w in layer_words
+    ]
+    meta = {**meta, "layer_nbytes": [len(p) for p in payloads]}
+    header = json.dumps(
+        {
+            "config": base_config.to_dict(),
+            "seq": seq,
+            "format": "scalable_stack",
+            "time": time.time(),
+            "extra": extra or {},
+            "scalable": meta,
+        }
+    ).encode()
+    return MAGIC + len(header).to_bytes(8, "little") + header + b"".join(payloads)
 
 
 def _deserialize(data: bytes) -> Tuple[dict, bytes]:
@@ -186,6 +220,21 @@ class RedisSink:
         self._client.close()
 
 
+def _device_snapshot(words):
+    """Copy ``words`` out of donation's reach and start the D2H transfer.
+
+    jax.Array: snapshot to a fresh device buffer (immune to the next
+    insert donating the original), then start the async copy; NumPy:
+    plain copy."""
+    if hasattr(words, "copy_to_host_async"):
+        import jax.numpy as jnp
+
+        words = jnp.array(words, copy=True)
+        words.copy_to_host_async()
+        return words
+    return np.array(words, copy=True)
+
+
 def _usage_extra(filter_obj) -> dict:
     """Usage counters recorded in every checkpoint so restore can rebuild
     server stats."""
@@ -196,10 +245,20 @@ def _usage_extra(filter_obj) -> dict:
 
 
 def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] = None) -> int:
-    """Synchronous snapshot of any filter (plain/counting/sharded)."""
+    """Synchronous snapshot of any filter (plain/counting/sharded/scalable)."""
     seq = seq if seq is not None else int(time.time() * 1000)
-    words = np.asarray(filter_obj.words)
     full_extra = {**_usage_extra(filter_obj), **(extra or {})}
+    if hasattr(filter_obj, "layers"):  # scalable layer stack
+        blob = _serialize_scalable(
+            filter_obj.base_config,
+            filter_obj.snapshot_meta(),
+            seq,
+            [np.asarray(layer.words) for layer in filter_obj.layers],
+            full_extra,
+        )
+        sink.put(filter_obj.base_config.key_name, seq, blob)
+        return seq
+    words = np.asarray(filter_obj.words)
     sink.put(
         filter_obj.config.key_name,
         seq,
@@ -208,19 +267,92 @@ def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] =
     return seq
 
 
-def restore(config: FilterConfig, sink, *, seq: Optional[int] = None):
+#: Growth-policy fields that must match between a scalable checkpoint and a
+#: restore request — they determine every layer's (m, k, seed) geometry.
+SCALABLE_POLICY_FIELDS = ("capacity", "error_rate", "growth", "tightening")
+
+
+def _restore_scalable(config: FilterConfig, header: dict, payload: bytes,
+                      expect: Optional[dict] = None):
+    """Rebuild a ScalableBloomFilter from a ``scalable_stack`` blob.
+
+    ``config`` is the base/template config (what you would pass as
+    ``ScalableBloomFilter(config=...)``); its identity fields must match
+    the checkpoint's stored base config. ``expect`` optionally pins the
+    growth-policy parameters (server CreateFilter passes the request's)."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    saved = header["config"]
+    field = identity_mismatch(saved, config, IDENTITY_FIELDS_SCALABLE)
+    if field is not None:
+        raise ValueError(
+            f"scalable checkpoint/config mismatch on base {field}: "
+            f"saved={saved.get(field, '<absent: default>')} "
+            f"requested={getattr(config, field)}"
+        )
+    meta = header["scalable"]
+    if expect is not None:
+        for f in SCALABLE_POLICY_FIELDS:
+            if f in expect and expect[f] != meta[f]:
+                raise ValueError(
+                    f"scalable checkpoint/policy mismatch on {f}: "
+                    f"saved={meta[f]} requested={expect[f]}"
+                )
+    f = ScalableBloomFilter(
+        meta["capacity"],
+        meta["error_rate"],
+        config=config,
+        growth=meta["growth"],
+        tightening=meta["tightening"],
+    )
+    words, off = [], 0
+    for nbytes in meta["layer_nbytes"]:
+        words.append(
+            np.frombuffer(payload[off : off + nbytes], dtype="<u4").astype(
+                np.uint32
+            )
+        )
+        off += nbytes
+    f._load_layers(meta, words)
+    f._restored_seq = header["seq"]
+    f._restored_meta = header.get("extra", {})
+    return f
+
+
+def restore(
+    config: FilterConfig,
+    sink,
+    *,
+    seq: Optional[int] = None,
+    scalable_expect: Optional[dict] = None,
+    expect_scalable: Optional[bool] = None,
+):
     """Rebuild a filter from the newest (or given) checkpoint in ``sink``.
 
     Returns a BloomFilter / BlockedBloomFilter / CountingBloomFilter /
-    BlockedCountingBloomFilter / ShardedBloomFilter according to
-    ``config``, or None if the sink has no checkpoint.
-    Config identity (m, k, seed, counting) must match the checkpoint —
-    positions are only portable between identical hash configs.
+    BlockedCountingBloomFilter / ShardedBloomFilter / ScalableBloomFilter
+    according to ``config`` and the stored format, or None if the sink has
+    no checkpoint. Config identity (m, k, seed, counting) must match the
+    checkpoint — positions are only portable between identical hash
+    configs. For ``scalable_stack`` blobs, ``config`` is the base/template
+    config and ``scalable_expect`` optionally pins the growth policy.
+    ``expect_scalable`` (when not None) rejects a blob of the other kind
+    up front — before any device arrays are built.
     """
     blob = sink.get(config.key_name, seq)
     if blob is None:
         return None
     header, payload = _deserialize(blob)
+    is_stack = header["format"] == "scalable_stack"
+    if expect_scalable is not None and is_stack != expect_scalable:
+        raise ValueError(
+            f"checkpoint for {config.key_name!r} holds a "
+            f"{'scalable layer stack' if is_stack else 'fixed-size filter'}; "
+            f"requested a "
+            f"{'scalable' if expect_scalable else 'fixed-size'} filter"
+        )
+    if is_stack:
+        return _restore_scalable(config, header, payload, scalable_expect)
     saved = header["config"]
     field = identity_mismatch(saved, config)
     if field is not None:
@@ -317,11 +449,10 @@ class AsyncCheckpointer:
             item = self._queue.get()
             if item is None:
                 return
-            seq, words, extra = item
+            seq, key_name, blob_fn = item
             try:
-                # np.asarray blocks until the async D2H copy lands.
-                blob = _serialize(self.filter.config, seq, np.asarray(words), extra)
-                self.sink.put(self.filter.config.key_name, seq, blob)
+                # blob_fn blocks until the async D2H copies land.
+                self.sink.put(key_name, seq, blob_fn())
                 self.checkpoints_written += 1
                 self.last_error = None  # a success clears a transient failure
             except Exception as e:  # surfaced via last_error + health checks
@@ -346,20 +477,29 @@ class AsyncCheckpointer:
                 return False
             self._busy.set()
             self._seq = max(self._seq + 1, int(time.time() * 1000))
-            words = self.filter.words
             extra = _usage_extra(self.filter)
             if self.meta_fn:
                 extra.update(self.meta_fn())
-        if hasattr(words, "copy_to_host_async"):
-            # jax.Array: snapshot to a fresh device buffer (immune to the
-            # next insert donating the original), then start the D2H copy.
-            import jax.numpy as jnp
-
-            words = jnp.array(words, copy=True)
-            words.copy_to_host_async()
-        else:
-            words = np.array(words, copy=True)
-        self._queue.put((self._seq, words, extra))
+            seq = self._seq
+            if hasattr(self.filter, "layers"):
+                # scalable: snapshot every layer + the stack meta NOW
+                # (consistent under the caller's op lock; layers may grow
+                # after trigger returns)
+                base = self.filter.base_config
+                meta = self.filter.snapshot_meta()
+                words_list = [
+                    _device_snapshot(layer.words) for layer in self.filter.layers
+                ]
+                blob_fn = (
+                    lambda: _serialize_scalable(base, meta, seq, words_list, extra)
+                )
+                key_name = base.key_name
+            else:
+                cfg = self.filter.config
+                words = _device_snapshot(self.filter.words)
+                blob_fn = lambda: _serialize(cfg, seq, np.asarray(words), extra)
+                key_name = cfg.key_name
+        self._queue.put((seq, key_name, blob_fn))
         return True
 
     def flush(self, timeout: float = 60.0) -> bool:

@@ -205,6 +205,12 @@ class CPUBlockedBloomFilter:
         set_bits = int(np.unpackbits(self.words.view(np.uint8)).sum())
         return set_bits / self.config.m
 
+    def _set_words(self, words) -> None:
+        """Replace storage from a flat array (checkpoint restore)."""
+        self.words = (
+            np.asarray(words, dtype=np.uint32).reshape(self.words.shape).copy()
+        )
+
     def to_bytes(self) -> bytes:
         return self.words.astype("<u4").tobytes()
 
@@ -294,6 +300,12 @@ class CPUBloomFilter:
     def clear(self) -> None:
         self.words[:] = 0
         self.n_inserted = 0
+
+    def _set_words(self, words) -> None:
+        """Replace storage from a flat array (checkpoint restore)."""
+        self.words = (
+            np.asarray(words, dtype=np.uint32).reshape(self.words.shape).copy()
+        )
 
     # -- counting-filter ops ------------------------------------------------
 

@@ -288,6 +288,12 @@ class _FilterBase:
     def block_until_ready(self) -> None:
         self.words.block_until_ready()
 
+    def _set_words(self, words) -> None:
+        """Replace storage from a flat array (checkpoint restore)."""
+        self.words = jnp.asarray(
+            np.asarray(words, dtype=np.uint32).reshape(self.words.shape)
+        )
+
     def clear(self) -> None:
         """Reference ``#clear`` — zero the array (SURVEY.md §3.4: DEL becomes
         ``jnp.zeros_like``)."""
@@ -385,6 +391,13 @@ class BlockedBloomFilter(_FilterBase):
     """
 
     def __init__(self, config: FilterConfig):
+        if config.counting:
+            # a counting config reinterprets m as counters (4 bits each);
+            # building a plain blocked filter from it would silently use
+            # the wrong geometry and drop delete support
+            raise ValueError(
+                "use BlockedCountingBloomFilter for counting configs"
+            )
         if not config.block_bits:
             config = config.replace(block_bits=512)
         super().__init__(config, 0)  # placeholder; storage is 2-D

@@ -143,6 +143,14 @@ def sweep_applicable(
     P = max(1, n_blocks // R)
     if n_blocks % R != 0 or R % 32 != 0:
         return False
+    if batch * R < 8 * n_blocks:
+        # minimum per-partition occupancy (lambda >= 8): the sweep streams
+        # the WHOLE block array HBM->VMEM->HBM per call, so a sparse batch
+        # (e.g. a scalar insert into a 2^23-block filter) would pay the
+        # full-array stream for a handful of rows — orders of magnitude
+        # slower than the row scatter. Break-even on v5e is lambda ~1
+        # (NB*128B / 819GB/s vs ~100ns/row scatter); 8 adds margin.
+        return False
     # kmax covers lambda + 8 sigma by construction unless the 1024 cap
     # binds (tiny filter / huge batch), where the chunk loop would
     # serialize every partition

@@ -81,6 +81,13 @@ class _ScalableCore:
         growth: int = 2,
         tightening: float = 0.5,
     ):
+        if config.counting:
+            # layered delete is ill-defined (which layer holds the key?);
+            # the counting variants are standalone filters, not layers
+            raise ValueError(
+                "scalable filters do not support counting configs — use "
+                "CountingBloomFilter / BlockedCountingBloomFilter"
+            )
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if not (0.0 < error_rate < 1.0):
@@ -159,7 +166,54 @@ class _ScalableCore:
         self.n_inserted = 0
         self._push_layer()
 
+    # -- persistence (layer-stack snapshot; tpubloom.checkpoint frames it) --
+
+    def snapshot_meta(self) -> dict:
+        """Everything needed to rebuild the layer stack except the payload
+        bytes: the growth-policy parameters (they determine every layer's
+        geometry) plus per-layer configs and fill counts. Captured under
+        the caller's op lock so it is consistent with the layer words."""
+        return {
+            "capacity": self.capacity,
+            "error_rate": self.error_rate,
+            "growth": self.growth,
+            "tightening": self.tightening,
+            "layer_counts": list(self._layer_counts),
+            "layer_configs": [layer.config.to_dict() for layer in self.layers],
+        }
+
+    def _load_layers(self, meta: dict, layer_words) -> None:
+        """Replace the layer stack with a restored one (checkpoint restore).
+
+        ``layer_words``: one np.uint32 array per layer, flattened payload
+        order. Layer geometry is re-derived from the policy and verified
+        against the stored configs — a checkpoint from a different policy
+        or base config cannot be silently misread."""
+        self.layers = []
+        self._layer_caps = []
+        self._layer_counts = []
+        for i, (cfg_dict, count) in enumerate(
+            zip(meta["layer_configs"], meta["layer_counts"])
+        ):
+            self._push_layer()
+            got = self.layers[i].config.to_dict()
+            if got != cfg_dict:
+                raise ValueError(
+                    f"layer {i} config mismatch on restore: policy derives "
+                    f"{got}, checkpoint holds {cfg_dict}"
+                )
+            self.layers[i]._set_words(layer_words[i])
+            self._layer_counts[i] = int(count)
+        self.n_inserted = sum(self._layer_counts)
+
     # -- observability ------------------------------------------------------
+
+    @property
+    def config(self):
+        """The base/template config (key_name, layout, seed — NOT a layer's
+        m/k). Lets config-keyed plumbing (server registry, checkpoint
+        sinks) treat scalable filters uniformly."""
+        return self.base_config
 
     @property
     def n_layers(self) -> int:

@@ -73,20 +73,22 @@ class BloomClient:
         raw = self._calls[method](protocol.encode(req), timeout=self.timeout)
         return protocol.check(protocol.decode(raw))
 
-    def _maybe_counting(self, name: str) -> bool:
-        """True unless the filter is KNOWN to be non-counting.
+    def _maybe_nonidempotent_insert(self, name: str) -> bool:
+        """True unless a replayed insert on this filter is KNOWN harmless.
 
         Filters not created through this client (e.g. attached by name
-        after another process made them) have unknown countingness —
-        treated as counting, i.e. their inserts are never auto-retried,
-        because a replayed counting insert that did land
-        double-increments."""
+        after another process made them) have unknown type — treated as
+        non-idempotent, i.e. their inserts are never auto-retried.
+        Counting inserts are scatter-ADDs (a landed replay
+        double-increments); scalable inserts double-count layer fill,
+        growing layers at half occupancy."""
         creation = self._creations.get(name)
         if creation is None:
             return True
         return bool(
             creation.get("config", {}).get("counting")
             or creation.get("options", {}).get("counting")
+            or creation.get("scalable")
         )
 
     def _rpc(self, method: str, req: dict, *, force_no_retry: bool = False) -> dict:
@@ -95,7 +97,8 @@ class BloomClient:
         # later delete leaves residue (stuck false positives). Same reason
         # DeleteBatch is never retried.
         no_retry = force_no_retry or method in _NO_RETRY or (
-            method == "InsertBatch" and self._maybe_counting(req.get("name", ""))
+            method == "InsertBatch"
+            and self._maybe_nonidempotent_insert(req.get("name", ""))
         )
         retries = 0 if no_retry else self.max_retries
         recreated = False
@@ -151,10 +154,29 @@ class BloomClient:
         config: Optional[dict] = None,
         exist_ok: bool = False,
         restore: bool = True,
+        scalable: bool = False,
+        growth: int = 2,
+        tightening: float = 0.5,
         **options,
     ) -> dict:
+        """``scalable=True`` creates a scalable (layered) filter: it grows
+        past ``capacity`` by pushing larger, tighter layers while the
+        compound FPR stays below ``error_rate / (1 - tightening)``.
+        Scalable filters are sized by capacity/error_rate (not a raw
+        ``config``); ``options`` become the base layer template
+        (key_len, block_bits, seed, ...)."""
         req: dict = {"name": name, "exist_ok": exist_ok, "restore": restore}
-        if config is not None:
+        if scalable:
+            if config is not None:
+                raise ValueError(
+                    "scalable filters are sized by capacity/error_rate, "
+                    "not a raw config"
+                )
+            req["capacity"] = capacity
+            req["error_rate"] = error_rate
+            req["options"] = options
+            req["scalable"] = {"growth": growth, "tightening": tightening}
+        elif config is not None:
             req["config"] = config
         else:
             req["capacity"] = capacity
@@ -165,7 +187,26 @@ class BloomClient:
         # remember the adopted config so the NOT_FOUND heal can replay a
         # well-formed creation.
         if config is None and capacity is None:
-            self._creations[name] = {"name": name, "config": resp["config"]}
+            if "scalable" in resp:
+                # replay a scalable creation: policy from the response,
+                # base template = adopted config minus the placeholder m/k
+                opts = {
+                    k: v
+                    for k, v in resp["config"].items()
+                    if k not in ("m", "k", "key_name")
+                }
+                self._creations[name] = {
+                    "name": name,
+                    "capacity": resp["scalable"]["capacity"],
+                    "error_rate": resp["scalable"]["error_rate"],
+                    "options": opts,
+                    "scalable": {
+                        "growth": resp["scalable"]["growth"],
+                        "tightening": resp["scalable"]["tightening"],
+                    },
+                }
+            else:
+                self._creations[name] = {"name": name, "config": resp["config"]}
         else:
             self._creations[name] = req
         return resp
@@ -208,6 +249,12 @@ class BloomClient:
 
     @staticmethod
     def _unpack_bool(resp: dict, field: str) -> np.ndarray:
+        if field not in resp:
+            raise protocol.BloomServiceError(
+                "UNSUPPORTED",
+                f"server response has no '{field}' field — the server is "
+                f"probably too old for this request (got {sorted(resp)})",
+            )
         return np.unpackbits(
             np.frombuffer(resp[field], np.uint8), count=resp["n"]
         ).astype(bool)

@@ -101,18 +101,101 @@ class BloomService:
             **req.get("options", {}),
         )
 
+    @staticmethod
+    def _parse_scalable(req: dict, name: str):
+        """``req["scalable"]`` (truthy; optionally ``{"growth", "tightening"}``)
+        -> (base template FilterConfig, growth-policy dict)."""
+        sc = req.get("scalable")
+        sc = sc if isinstance(sc, dict) else {}
+        if req.get("capacity") is None or req.get("error_rate") is None:
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT",
+                "scalable filters are sized by capacity + error_rate",
+            )
+        opts = dict(req.get("options", {}))
+        # template m is a placeholder (layers derive their own) but must
+        # satisfy config validation for blocked layouts
+        m0 = max(64, int(opts.get("block_bits") or 0))
+        base = FilterConfig(m=m0, k=1, key_name=name, **opts)
+        policy = {
+            "capacity": int(req["capacity"]),
+            "error_rate": float(req["error_rate"]),
+            "growth": int(sc.get("growth", 2)),
+            "tightening": float(sc.get("tightening", 0.5)),
+        }
+        return base, policy
+
+    @staticmethod
+    def _policy_of(filt) -> dict:
+        """Growth-policy dict of a live scalable filter (response echo +
+        exist_ok comparison)."""
+        return {
+            "capacity": filt.capacity,
+            "error_rate": filt.error_rate,
+            "growth": filt.growth,
+            "tightening": filt.tightening,
+        }
+
     def CreateFilter(self, req: dict) -> dict:
         name = req["name"]
+        want_scalable = bool(req.get("scalable"))
         with self._lock:
             if name in self._filters:
-                existing = self._filters[name].filter.config
+                existing_filt = self._filters[name].filter
+                existing = existing_filt.config
+                existing_scalable = hasattr(existing_filt, "layers")
                 if req.get("exist_ok", False):
                     # Attaching to an existing filter must mean the SAME
                     # filter — a silent mismatch would e.g. pour 1e8 keys
                     # into a 1e3-capacity array while the caller believes
                     # it requested 1% FPR. A bare attach (no config/capacity
                     # given) adopts the existing config as-is.
-                    if "config" in req or req.get("capacity") is not None:
+                    has_params = "config" in req or req.get("capacity") is not None
+                    if (want_scalable or has_params) and (
+                        want_scalable != existing_scalable
+                    ):
+                        raise protocol.BloomServiceError(
+                            "CONFIG_MISMATCH",
+                            f"filter {name!r} exists as "
+                            f"{'scalable' if existing_scalable else 'fixed-size'}, "
+                            f"requested {'scalable' if want_scalable else 'fixed-size'}",
+                        )
+                    if want_scalable:
+                        # verify every parameter the request actually
+                        # carries (a bare attach carries none; the stock
+                        # client always transmits growth/tightening, so
+                        # a changed default is caught even w/o capacity)
+                        sc = req.get("scalable")
+                        sc = sc if isinstance(sc, dict) else {}
+                        requested = {}
+                        if req.get("capacity") is not None:
+                            requested["capacity"] = int(req["capacity"])
+                        if req.get("error_rate") is not None:
+                            requested["error_rate"] = float(req["error_rate"])
+                        if "growth" in sc:
+                            requested["growth"] = int(sc["growth"])
+                        if "tightening" in sc:
+                            requested["tightening"] = float(sc["tightening"])
+                        live = self._policy_of(existing_filt)
+                        field = next(
+                            (f for f, v in requested.items() if live[f] != v),
+                            None,
+                        )
+                        if field is None and req.get("options"):
+                            opts = dict(req["options"])
+                            m0 = max(64, int(opts.get("block_bits") or 0))
+                            base = FilterConfig(m=m0, k=1, key_name=name, **opts)
+                            field = identity_mismatch(
+                                existing, base,
+                                ckpt.IDENTITY_FIELDS_SCALABLE + ("key_len",),
+                            )
+                        if field is not None:
+                            raise protocol.BloomServiceError(
+                                "CONFIG_MISMATCH",
+                                f"scalable filter {name!r} exists with a "
+                                f"different {field}",
+                            )
+                    elif has_params:
                         config = self._parse_config(req, name)
                         field = identity_mismatch(
                             existing, config, IDENTITY_FIELDS + ("key_len",)
@@ -124,20 +207,25 @@ class BloomService:
                                 f"{getattr(existing, field)}, requested "
                                 f"{getattr(config, field)}",
                             )
-                    return {
+                    resp = {
                         "ok": True,
                         "existed": True,
                         "config": existing.to_dict(),
                     }
+                    if existing_scalable:
+                        resp["scalable"] = self._policy_of(existing_filt)
+                    return resp
                 raise protocol.BloomServiceError(
                     "ALREADY_EXISTS", f"filter {name!r} exists"
                 )
+            if want_scalable:
+                return self._create_scalable(req, name)
             config = self._parse_config(req, name)
             sink = self._sink_factory(config)
             restored = None
             if sink is not None and req.get("restore", True):
                 try:
-                    restored = ckpt.restore(config, sink)
+                    restored = ckpt.restore(config, sink, expect_scalable=False)
                 except ValueError as e:
                     raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
             if restored is not None:
@@ -169,6 +257,44 @@ class BloomService:
                 "restored_seq": getattr(filt, "_restored_seq", None),
                 "config": config.to_dict(),
             }
+
+    def _create_scalable(self, req: dict, name: str) -> dict:
+        """Scalable-filter CreateFilter branch (caller holds self._lock).
+
+        Parity: the scalable/layered filter is the reference's Lua-lineage
+        capability (SURVEY.md §2.3); serving + restore-on-create makes it a
+        first-class server citizen like the fixed-size variants."""
+        from tpubloom.scalable import ScalableBloomFilter
+
+        base, policy = self._parse_scalable(req, name)
+        sink = self._sink_factory(base)
+        restored = None
+        if sink is not None and req.get("restore", True):
+            try:
+                restored = ckpt.restore(
+                    base, sink, scalable_expect=policy, expect_scalable=True
+                )
+            except ValueError as e:
+                raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
+        if restored is not None:
+            filt = restored
+        else:
+            filt = ScalableBloomFilter(
+                policy["capacity"],
+                policy["error_rate"],
+                config=base,
+                growth=policy["growth"],
+                tightening=policy["tightening"],
+            )
+        self._filters[name] = _Managed(filt, sink, base.checkpoint_every)
+        self.metrics.count("filters_created")
+        return {
+            "ok": True,
+            "existed": False,
+            "restored_seq": getattr(filt, "_restored_seq", None),
+            "config": base.to_dict(),
+            "scalable": policy,
+        }
 
     def DropFilter(self, req: dict) -> dict:
         with self._lock:

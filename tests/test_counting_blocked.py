@@ -113,6 +113,7 @@ def test_query_requires_all_counters(config):
             block_bits=config.counters_per_block,
             k=config.k,
             seed=config.seed,
+            block_hash=config.block_hash,
         )
     )(jnp.asarray(ku), jnp.asarray(kl))
     blk = int(np.asarray(blk)[0])

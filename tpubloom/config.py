@@ -23,7 +23,9 @@ DEFAULT_SEED = 0x9747B28C
 #: these produce interchangeable bit arrays (positions are only portable
 #: between identical hash configs; shards is identity-relevant because the
 #: sharded payload is shard-major with per-shard-local positions).
-IDENTITY_FIELDS = ("m", "k", "seed", "counting", "shards", "block_bits")
+IDENTITY_FIELDS = (
+    "m", "k", "seed", "counting", "shards", "block_bits", "block_hash"
+)
 
 
 def identity_mismatch(a, b, fields=IDENTITY_FIELDS):
@@ -34,6 +36,11 @@ def identity_mismatch(a, b, fields=IDENTITY_FIELDS):
         if isinstance(c, dict):
             if f in c:
                 return c[f]
+            if f == "block_hash":
+                # headers serialized before the field existed were written
+                # by the AP in-block spec (the only one that existed then),
+                # NOT the current default — see FilterConfig.from_dict
+                return "ap" if c.get("block_bits", 0) else ""
             # configs serialized before a field existed (e.g. block_bits in
             # old checkpoint headers) compare as the field's default
             default = FilterConfig.__dataclass_fields__[f].default
@@ -81,6 +88,19 @@ class FilterConfig:
         qualifies and the sorted-scatter XLA path otherwise; ``"sweep"``
         / ``"scatter"`` force one. Not part of the filter's identity —
         both paths produce bit-identical arrays.
+      block_hash: in-block position derivation for the blocked layout
+        (part of the filter's identity). ``"chunk"`` (the default when it
+        fits) slices each position from disjoint bit ranges of the
+        (h_b, g_a, g_b) 96-bit hash pool — positions are i.i.d. uniform.
+        ``"ap"`` is the legacy arithmetic-progression walk
+        ``(g_a + i*(g_b|1)) mod block_bits``, whose position sets form a
+        tiny 2-parameter family: two same-block keys colliding in
+        (g_a mod b, g_b mod b) share ALL positions, which puts a measured
+        FPR floor of ~4*load/block_bits^2 under every blocked filter
+        (see params.blocked_fpr). ``"auto"`` resolves to "chunk" when
+        k*log2(in-block positions) <= 96, else "ap". Flat layouts carry
+        ``""``. Checkpoint headers written before this field existed
+        restore as "ap" (the spec they were built with).
     """
 
     m: int
@@ -94,6 +114,7 @@ class FilterConfig:
     checkpoint_every: int = 0
     block_bits: int = 0
     insert_path: str = "auto"
+    block_hash: str = "auto"
 
     def __post_init__(self) -> None:
         if self.m <= 0:
@@ -159,6 +180,31 @@ class FilterConfig:
                         f"m ({self.m}) must be divisible by shards*block_bits "
                         f"({self.shards * bb})"
                     )
+        # resolve/validate the in-block hash spec (identity field)
+        if self.block_bits:
+            domain = self.block_bits // 4 if self.counting else self.block_bits
+            nb = (domain - 1).bit_length()
+            fits = self.k * nb <= 96  # the (h_b, g_a, g_b) pool
+            bh = self.block_hash
+            if bh == "auto":
+                bh = "chunk" if fits else "ap"
+                object.__setattr__(self, "block_hash", bh)
+            if self.block_hash not in ("chunk", "ap"):
+                raise ValueError(
+                    f"block_hash must be auto/chunk/ap, got {self.block_hash!r}"
+                )
+            if self.block_hash == "chunk" and not fits:
+                raise ValueError(
+                    f"block_hash='chunk' needs k*log2(in-block positions) <= 96 "
+                    f"(k={self.k}, {nb} bits/position) — use 'ap'"
+                )
+        else:
+            if self.block_hash not in ("", "auto"):
+                raise ValueError(
+                    "block_hash is only meaningful for blocked layouts "
+                    f"(block_bits=0), got {self.block_hash!r}"
+                )
+            object.__setattr__(self, "block_hash", "")
 
     # -- derived layout ----------------------------------------------------
 
@@ -244,6 +290,11 @@ class FilterConfig:
         return cls(m=m, k=k, **kwargs)
 
     def replace(self, **kwargs) -> "FilterConfig":
+        if "block_bits" in kwargs and "block_hash" not in kwargs:
+            # crossing the flat<->blocked boundary invalidates the resolved
+            # in-block spec ("" <-> chunk/ap); re-resolve from "auto"
+            if bool(kwargs["block_bits"]) != bool(self.block_bits):
+                kwargs["block_hash"] = "auto"
         return dataclasses.replace(self, **kwargs)
 
     def to_dict(self) -> dict:
@@ -251,4 +302,7 @@ class FilterConfig:
 
     @classmethod
     def from_dict(cls, d: dict) -> "FilterConfig":
+        if d.get("block_bits") and "block_hash" not in d:
+            # serialized before the field existed == built with the AP spec
+            d = dict(d, block_hash="ap")
         return cls(**d)

@@ -111,18 +111,40 @@ def blocked_positions_np(
     block_bits: int,
     k: int,
     seed: int,
+    block_hash: str = "ap",
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Blocked-spec coordinates (mirrors tpubloom.ops.blocked.block_positions):
-    returns ``(blk int64[B], bit uint32[B, k])``."""
+    """Blocked-spec coordinates (mirrors tpubloom.ops.blocked.block_positions,
+    both in-block variants): returns ``(blk int64[B], bit uint32[B, k])``."""
     h_a = murmur3_32_np(keys, lengths, seed)
     g_a = fnv1a_32_np(keys, lengths)
     g_b = murmur3_32_np(keys, lengths, seed ^ SEED_XOR_GB)
     blk = (h_a & np.uint32(n_blocks - 1)).astype(np.int64)
+    mask = np.uint32(block_bits - 1)
+    if block_hash == "chunk":
+        nb = (block_bits - 1).bit_length()
+        if k * nb > 96:
+            raise ValueError(
+                f"chunk in-block hash needs k*log2(block_bits) <= 96 "
+                f"(k={k}, {nb} bits/position)"
+            )
+        h_b = murmur3_32_np(keys, lengths, seed ^ SEED_XOR_HB)
+        pool = (h_b, g_a, g_b)
+        cols = []
+        for i in range(k):
+            sh = i * nb
+            w, off = sh >> 5, sh & 31
+            v = pool[w] >> np.uint32(off)
+            if off + nb > 32:
+                v = v | (pool[w + 1] << np.uint32(32 - off))
+            cols.append(v & mask)
+        return blk, np.stack(cols, axis=-1)
+    if block_hash != "ap":
+        raise ValueError(f"block_hash must be 'chunk' or 'ap', got {block_hash!r}")
     stride = g_b | np.uint32(1)
     i = np.arange(k, dtype=np.uint32)
     with np.errstate(over="ignore"):
         p = g_a[..., None] + i * stride[..., None]  # u32 wrap == mod 2^32
-    return blk, p & np.uint32(block_bits - 1)
+    return blk, p & mask
 
 
 class CPUBlockedBloomFilter:
@@ -158,6 +180,7 @@ class CPUBlockedBloomFilter:
             block_bits=self.config.block_bits,
             k=self.config.k,
             seed=self.config.seed,
+            block_hash=self.config.block_hash,
         )
 
     def _coords(self, keys: Sequence[bytes | str]):

@@ -121,7 +121,7 @@ def make_blocked_insert_fn(config: FilterConfig):
     per (backend, batch shape) at trace time.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def insert(blocks, keys_u8, lengths):
         from tpubloom.ops import sweep
@@ -131,7 +131,7 @@ def make_blocked_insert_fn(config: FilterConfig):
         valid = lengths >= 0
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=bb, k=k, seed=seed,
+            n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
         return blocked.blocked_insert(blocks, blk, masks, valid)
@@ -152,7 +152,7 @@ def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
     computes via ops.counting.counter_update on the raveled array.
     """
     nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def update(blocks, keys_u8, lengths):
         from tpubloom.ops import sweep
@@ -172,7 +172,7 @@ def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
         valid = lengths >= 0
         blk, cpos = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
         gpos = (blk[..., None] * cpb + cpos.astype(jnp.int32)).astype(jnp.int32)
         valid_k = jnp.broadcast_to(valid[..., None], gpos.shape)
@@ -188,12 +188,12 @@ def make_blocked_counting_query_fn(config: FilterConfig):
     """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked-counting
     membership: one row gather per key + all-counters-nonzero test."""
     nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def query(blocks, keys_u8, lengths):
         blk, cpos = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
         rows = blocks[blk]  # [B, W]
         word = (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k] in [0, W)
@@ -218,7 +218,7 @@ def make_blocked_test_insert_fn(config: FilterConfig):
     measurably faster than separate query + insert steps.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def test_insert(blocks, keys_u8, lengths):
         from tpubloom.ops import sweep
@@ -232,7 +232,7 @@ def make_blocked_test_insert_fn(config: FilterConfig):
         valid = lengths >= 0
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=bb, k=k, seed=seed,
+            n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
         present = blocked.blocked_query(blocks, blk, masks) & valid
@@ -244,12 +244,12 @@ def make_blocked_test_insert_fn(config: FilterConfig):
 def make_blocked_query_fn(config: FilterConfig):
     """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked membership."""
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def query(blocks, keys_u8, lengths):
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=bb, k=k, seed=seed,
+            n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
         return blocked.blocked_query(blocks, blk, masks)

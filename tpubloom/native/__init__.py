@@ -64,8 +64,8 @@ def _load():
         lib.bh_query.argtypes = [u32p, u64p, ctypes.c_int64, ctypes.c_int32, u8p]
         lib.bh_hash_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32]
         lib.bh_hash_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32, u8p]
-        lib.bh_blocked_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32]
-        lib.bh_blocked_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, u8p]
+        lib.bh_blocked_insert.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32]
+        lib.bh_blocked_query.argtypes = [u32p, u8p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32, u8p]
         lib.bh_pack.argtypes = [u8p, i32p, ctypes.c_int64, ctypes.c_int32, u8p]
         _lib = lib
         HAS_NATIVE = True
@@ -135,7 +135,7 @@ def hash_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int
     )
 
 
-def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int) -> None:
+def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int, block_hash: str = "ap") -> None:
     """Fused blocked-spec insert into ``uint32[n_blocks, W]`` (in place)."""
     lib = _load()
     assert lib is not None
@@ -145,11 +145,11 @@ def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_b
     lib.bh_blocked_insert(
         _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
         _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(n_blocks),
-        block_bits, k, ctypes.c_uint32(seed),
+        block_bits, k, ctypes.c_uint32(seed), int(block_hash == "chunk"),
     )
 
 
-def blocked_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int) -> np.ndarray:
+def blocked_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int, block_hash: str = "ap") -> np.ndarray:
     lib = _load()
     assert lib is not None
     keys = np.ascontiguousarray(keys, dtype=np.uint8)
@@ -159,7 +159,8 @@ def blocked_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_bl
     lib.bh_blocked_query(
         _ptr(words, ctypes.c_uint32), _ptr(keys, ctypes.c_uint8),
         _ptr(lens, ctypes.c_int32), B, L, ctypes.c_uint64(n_blocks),
-        block_bits, k, ctypes.c_uint32(seed), _ptr(out, ctypes.c_uint8),
+        block_bits, k, ctypes.c_uint32(seed), int(block_hash == "chunk"),
+        _ptr(out, ctypes.c_uint8),
     )
     return out
 

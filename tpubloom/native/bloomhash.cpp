@@ -208,53 +208,71 @@ void bh_hash_query(const uint32_t* words, const uint8_t* keys,
 }
 
 // Blocked (cache-line) layout — fused hash+insert / hash+query, exact spec
-// of tpubloom/ops/blocked.py: blk = h_a mod n_blocks; in-block bit_i =
+// of tpubloom/ops/blocked.py: blk = h_a mod n_blocks. In-block positions per
+// the `chunk` flag: chunk=1 slices log2(block_bits)-bit chunks from the
+// (h_b, g_a, g_b) 96-bit pool; chunk=0 is the legacy AP walk
 // (g_a + i*(g_b|1)) mod block_bits. words is uint32[n_blocks * W] row-major,
 // W = block_bits/32.
+static inline void blocked_positions_one(const uint8_t* key, int len,
+                                         uint32_t seed, int32_t block_bits,
+                                         int32_t k, int32_t chunk,
+                                         uint32_t* bits) {
+  const uint32_t bmask = (uint32_t)block_bits - 1u;
+  const uint32_t g_a = fnv1a_32(key, len);
+  const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
+  if (chunk) {
+    int nb = 0;
+    while ((1 << nb) < block_bits) nb++;
+    const uint32_t pool[3] = {murmur3_32(key, len, seed ^ SEED_XOR_HB), g_a,
+                              g_b};
+    for (int j = 0; j < k; j++) {
+      const int sh = j * nb;
+      const int w = sh >> 5, off = sh & 31;
+      uint32_t v = pool[w] >> off;
+      if (off + nb > 32) v |= pool[w + 1] << (32 - off);
+      bits[j] = v & bmask;
+    }
+  } else {
+    const uint32_t stride = g_b | 1u;
+    uint32_t p = g_a;
+    for (int j = 0; j < k; j++) {
+      bits[j] = p & bmask;
+      p += stride;  // u32 wrap == mod 2^32
+    }
+  }
+}
+
 void bh_blocked_insert(uint32_t* words, const uint8_t* keys,
                        const int32_t* lens, int64_t B, int32_t L,
                        uint64_t n_blocks, int32_t block_bits, int32_t k,
-                       uint32_t seed) {
-  const uint32_t bmask = (uint32_t)block_bits - 1u;
+                       uint32_t seed, int32_t chunk) {
   const int64_t W = block_bits / 32;
+  uint32_t bits[64];
   for (int64_t i = 0; i < B; i++) {
     const uint8_t* key = keys + i * L;
     const int len = lens[i];
     const uint32_t h_a = murmur3_32(key, len, seed);
-    const uint32_t g_a = fnv1a_32(key, len);
-    const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
-    const uint32_t stride = g_b | 1u;
+    blocked_positions_one(key, len, seed, block_bits, k, chunk, bits);
     uint32_t* row = words + (uint64_t)(h_a & (uint32_t)(n_blocks - 1)) * W;
-    uint32_t p = g_a;
-    for (int j = 0; j < k; j++) {
-      const uint32_t bit = p & bmask;
-      row[bit >> 5] |= 1u << (bit & 31);
-      p += stride;  // u32 wrap == mod 2^32
-    }
+    for (int j = 0; j < k; j++) row[bits[j] >> 5] |= 1u << (bits[j] & 31);
   }
 }
 
 void bh_blocked_query(const uint32_t* words, const uint8_t* keys,
                       const int32_t* lens, int64_t B, int32_t L,
                       uint64_t n_blocks, int32_t block_bits, int32_t k,
-                      uint32_t seed, uint8_t* out) {
-  const uint32_t bmask = (uint32_t)block_bits - 1u;
+                      uint32_t seed, int32_t chunk, uint8_t* out) {
   const int64_t W = block_bits / 32;
+  uint32_t bits[64];
   for (int64_t i = 0; i < B; i++) {
     const uint8_t* key = keys + i * L;
     const int len = lens[i];
     const uint32_t h_a = murmur3_32(key, len, seed);
-    const uint32_t g_a = fnv1a_32(key, len);
-    const uint32_t g_b = murmur3_32(key, len, seed ^ SEED_XOR_GB);
-    const uint32_t stride = g_b | 1u;
+    blocked_positions_one(key, len, seed, block_bits, k, chunk, bits);
     const uint32_t* row = words + (uint64_t)(h_a & (uint32_t)(n_blocks - 1)) * W;
-    uint32_t p = g_a;
     uint8_t hit = 1;
-    for (int j = 0; j < k && hit; j++) {
-      const uint32_t bit = p & bmask;
-      hit &= (uint8_t)((row[bit >> 5] >> (bit & 31)) & 1u);
-      p += stride;
-    }
+    for (int j = 0; j < k && hit; j++)
+      hit &= (uint8_t)((row[bits[j] >> 5] >> (bits[j] & 31)) & 1u);
     out[i] = hit;
   }
 }

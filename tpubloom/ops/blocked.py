@@ -22,17 +22,42 @@ THE BLOCKED POSITION SPEC (canonical; CPU oracle + tests mirror it)
 Given the four base hashes of the flat spec (h_a, h_b, g_a, g_b — see
 tpubloom.ops.hashing), ``n_blocks = m / block_bits`` (both powers of 2):
 
-  blk     = h_a mod n_blocks                      # owning block
+  blk = h_a mod n_blocks                          # owning block
+
+and, with ``b`` the in-block position count (= block_bits here; the
+blocked COUNTING layout reuses this function with b = counters per
+block), TWO in-block variants selected by ``config.block_hash``:
+
+``"chunk"`` (default where it fits — see config.FilterConfig):
+
+  pool    = h_b | g_a<<32 | g_b<<64                # 96-bit hash pool
+  bit_i   = (pool >> (i·log2(b))) mod b,  i = 0..k-1
+
+i.e. each position reads a disjoint log2(b)-bit slice of the pool —
+positions are i.i.d. uniform. Requires k·log2(b) <= 96.
+
+``"ap"`` (legacy):
+
   p_i     = (g_a + i·(g_b | 1)) mod 2^32,  i = 0..k-1
-  bit_i   = p_i mod block_bits                    # position inside block
+  bit_i   = p_i mod b
+
+The AP variant's position SET is determined by just
+(g_a mod b, g_b mod b) — a 2-parameter family of arithmetic
+progressions. Two same-block keys colliding in those ~2·log2(b) bits
+share every position, which floors the filter's FPR at ~4·load/b²
+regardless of fill (measured: 1.6e-4 at the north-star shape where
+theory says 1e-6 — see params.blocked_fpr and tests/test_fpr_model.py).
+"chunk" removes that floor; "ap" remains supported to restore
+checkpoints written before the field existed.
 
 Bit ``bit_i`` of a block is bit ``bit_i mod 32`` (LSB-first) of word
 ``bit_i div 32`` in the block's ``uint32[block_bits/32]`` row. Blocked
 arrays are therefore NOT bit-compatible with flat arrays; the layout is
-part of the filter's identity (config.block_bits).
+part of the filter's identity (config.block_bits, config.block_hash).
 
-In-block positions may collide (the p_i stride walk can revisit a bit) —
-standard for blocked filters; the FPR tests measure the compound effect.
+AP in-block positions cannot collide within a key when b is a power of
+two (odd stride), chunk positions can (i.i.d.) — standard bloom
+behavior; the FPR model accounts for both.
 """
 
 from __future__ import annotations
@@ -56,24 +81,46 @@ def block_positions(
     block_bits: int,
     k: int,
     seed: int,
+    block_hash: str = "ap",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Blocked-spec coordinates of each key.
+    """Blocked-spec coordinates of each key (module docstring has the spec).
 
     Returns ``(blk, bit)``: ``blk`` int32[...], owning block per key;
-    ``bit`` uint32[..., k], in-block bit positions.
+    ``bit`` uint32[..., k], in-block bit positions. ``block_hash`` selects
+    the in-block variant ("chunk" / "ap"); callers with a FilterConfig
+    must pass ``config.block_hash`` — it is part of the filter identity.
     """
     h_a = hashing.murmur3_32(keys, lengths, seed)
     g_a = hashing.fnv1a_32(keys, lengths)
     g_b = hashing.murmur3_32(keys, lengths, seed ^ hashing.SEED_XOR_GB)
     blk = (h_a & _u32(n_blocks - 1)).astype(jnp.int32)
-    stride = g_b | _u32(1)
     mask = _u32(block_bits - 1)
     bits = []
-    p = g_a
-    for i in range(k):
-        if i > 0:
-            p = p + stride  # u32 wrap == mod 2^32
-        bits.append(p & mask)
+    if block_hash == "chunk":
+        nb = (block_bits - 1).bit_length()
+        if k * nb > 96:
+            raise ValueError(
+                f"chunk in-block hash needs k*log2(block_bits) <= 96 "
+                f"(k={k}, {nb} bits/position)"
+            )
+        h_b = hashing.murmur3_32(keys, lengths, seed ^ hashing.SEED_XOR_HB)
+        pool = (h_b, g_a, g_b)
+        for i in range(k):
+            sh = i * nb
+            w, off = sh >> 5, sh & 31
+            v = pool[w] >> _u32(off)
+            if off + nb > 32:
+                v = v | (pool[w + 1] << _u32(32 - off))
+            bits.append(v & mask)
+    elif block_hash == "ap":
+        stride = g_b | _u32(1)
+        p = g_a
+        for i in range(k):
+            if i > 0:
+                p = p + stride  # u32 wrap == mod 2^32
+            bits.append(p & mask)
+    else:
+        raise ValueError(f"block_hash must be 'chunk' or 'ap', got {block_hash!r}")
     return blk, jnp.stack(bits, axis=-1)
 
 

@@ -673,7 +673,7 @@ def make_sweep_counter_fn(
     (tpubloom.filter.make_blocked_counter_fn's fallback path).
     """
     nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def update(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
@@ -690,7 +690,7 @@ def make_sweep_counter_fn(
         valid = lengths >= 0
         blk, cpos = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=cpb, k=k, seed=seed,
+            n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
         blk = jnp.where(valid, blk, nb)
         cols, nbits, packed = _pack_positions(cpos, cpb, k)
@@ -816,7 +816,7 @@ def make_sweep_insert_fn(
     guarantees this); padded entries return False.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
-    k, seed = config.k, config.seed
+    k, seed, bh = config.k, config.seed, config.block_hash
 
     def insert(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
@@ -845,7 +845,7 @@ def make_sweep_insert_fn(
         valid = lengths >= 0
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
-            n_blocks=nb, block_bits=bb, k=k, seed=seed,
+            n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         if not with_presence:
             return apply_blocked_updates(

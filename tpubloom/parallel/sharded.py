@@ -158,7 +158,7 @@ def _routed_blocks(
     blk, bit = blocked.block_positions(
         keys_u8, lens,
         n_blocks=nbl, block_bits=config.block_bits, k=config.k,
-        seed=config.seed,
+        seed=config.seed, block_hash=config.block_hash,
     )
     masks = blocked.build_masks(bit, config.words_per_block)
     local_row = route - dev * shards_per_dev

@@ -222,7 +222,8 @@ def run_variant(level, starts, upd):
 
 def build_stream(keys):
     blk, bit = blocked.block_positions(
-        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
     )
     blk = blk.astype(jnp.uint32)
     cols, nbits, packed = _pack_positions(bit, BB, K)

@@ -73,14 +73,16 @@ def p0(state, carry, i):
 def p1(state, carry, i):
     keys = keygen(carry, i)
     blk, bit = blocked.block_positions(
-        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
     )
     return state, jnp.sum(blk.astype(jnp.uint32)) + jnp.sum(bit)
 
 
 def _sorted_cols(keys):
     blk, bit = blocked.block_positions(
-        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
     )
     cols, nbits, packed = _pack_positions(bit, BB, K)
     idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)

@@ -40,7 +40,8 @@ lengths = jnp.full((B,), KEY_LEN, jnp.int32)
 def build_stream(keys, R, KMAX):
     P = NB // R
     blk, bit = blocked.block_positions(
-        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
     )
     blk = blk.astype(jnp.uint32)
     cols, nbits, packed = _pack_positions(bit, BB, K)
@@ -56,9 +57,14 @@ def build_stream(keys, R, KMAX):
 
 
 def main():
+    import sys
+
     rng = np.random.default_rng(0)
     keys = jax.device_put(rng.integers(0, 256, (B, KEY_LEN), np.uint8))
-    for R in (128, 256, 512, 1024):
+    # R values from argv (fresh-process measurement: same-process runs
+    # after a 64k-step grid have produced impossible timings on axon)
+    r_list = tuple(int(a) for a in sys.argv[1:]) or (128, 256, 512, 1024)
+    for R in r_list:
         lam = B // (NB // R)
         _, KMAX = choose_params(NB, B, R=R)
         try:

@@ -1,0 +1,389 @@
+#!/usr/bin/env python
+"""sweep2 prototype — acting on the round-2/3 ablation data (VERDICT r3 #1).
+
+Measured facts at the north-star shape (B=4M, m=2^32, bb=512, R=512,
+KMAX=384, P=16384) from kernel_ablate on this chip:
+
+  A stream-only   46.6ms  -> ~90M keys/s ceiling of the CURRENT structure
+  C merge-free    72.6ms  (delta == shipping kernel, bit-identical)
+  D shipping      76.6ms
+
+so ~60% of the kernel is the A-floor (grid steps + update-stream DMA),
+and the merge machinery costs ~4ms once the delta is merge-free. The
+attacks, each a flag here so their contribution is measured separately:
+
+  * narrow update rows: [Btot, 32] lanes instead of [Btot, 128] — the
+    stream only carries block id + W mask words + idx = 18 words, so
+    128 lanes is 7x DMA waste (2GB/batch instead of 0.5GB).
+  * big grid tiles + sub-tiles: R_dma rows per grid step (fewer steps,
+    one big window DMA per step) while the one-hot placement matmul
+    keeps its own R_sub granularity (total MACs = NB*bb*KMAX_sub do
+    NOT grow with R_dma) via dynamic sublane slices of the window.
+  * int8 MXU for the placement matmul (operands are 0/1; v5e runs int8
+    at 2x bf16 rate).
+
+Insert-only (no presence), no overflow-chunk loop: the host asserts no
+sub-window overflows its KMAX_sub fetch window (uniform benchmark keys;
+the production port keeps the chunk loop). Every variant's final state
+is checked bit-identical (sampled) to the shipping sweep kernel.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/sweep2_proto.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _ALIGN,
+    _pack_positions,
+    _stream_scaffold,
+    _unpack_positions,
+    choose_params,
+    sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _delta_merge_free(sub, base, R_SUB, KMAX, W, int8: bool):
+    """uint32[R_SUB, W] OR-delta of update window ``sub`` ([KMAX, LANES]:
+    col 0 block id, cols 1..W masks) against rows [base, base+R_SUB)."""
+    rl = (sub[:, 0:1] - base).astype(jnp.int32)
+    colsR = lax.broadcasted_iota(jnp.int32, (KMAX, R_SUB), 1)
+    m = sub[:, 1 : W + 1]
+    colC = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
+    rep = jnp.concatenate([m] * 32, axis=1)
+    bits = (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
+    if int8:
+        oh = jnp.where(rl == colsR, 1, 0).astype(jnp.int8)
+        bits8 = bits.astype(jnp.int8)
+        cnt = lax.dot_general(
+            oh, bits8, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [R_SUB, W*32]
+        present = jnp.where(cnt > 0, jnp.float32(1), jnp.float32(0)).astype(
+            jnp.bfloat16
+        )
+    else:
+        oh = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0)).astype(
+            jnp.bfloat16
+        )
+        bitsf = bits.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+        cnt = lax.dot_general(
+            oh, bitsf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        present = jnp.where(cnt > 0, jnp.float32(1), jnp.float32(0)).astype(
+            jnp.bfloat16
+        )
+    # pack 512 bit-planes -> 4W 8-bit quarters -> W u32 words (all exact)
+    ccol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 0)
+    hcol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 1)
+    b_of_c = ccol // W
+    w_of_c = lax.rem(ccol, W)
+    pack_w = jnp.where(
+        (w_of_c + (b_of_c // 8) * W) == hcol,
+        (1 << lax.rem(b_of_c, 8)).astype(jnp.float32),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    quarters = lax.dot_general(
+        present, pack_w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.bfloat16)
+    qcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 0)
+    wcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 1)
+    q_of = qcol // W
+    w_of = lax.rem(qcol, W)
+    comb_lo = jnp.where(
+        (w_of == wcol) & (q_of < 2),
+        jnp.where(q_of == 0, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    comb_hi = jnp.where(
+        (w_of == wcol) & (q_of >= 2),
+        jnp.where(q_of == 2, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    lo = lax.dot_general(
+        quarters, comb_lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    hi = lax.dot_general(
+        quarters, comb_hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << _u32(16)
+    )
+
+
+def _kernel2(
+    starts_ref,  # SMEM [P_sub + 1] i32
+    upd_ref,  # ANY [Btot, LANES]
+    blocks_ref,  # VMEM [R_DMA, W]
+    out_ref,  # VMEM [R_DMA, W]
+    sup_ref,  # VMEM [2, KMAX_BIG, LANES]
+    sems,
+    *,
+    R_SUB: int,
+    S: int,
+    KMAX_SUB: int,
+    KMAX_BIG: int,
+    W: int,
+    INT8: bool,
+):
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+
+    def off_big(pp):
+        return (starts_ref[pp * S] // _ALIGN) * _ALIGN
+
+    def fetch(slot, pp):
+        pltpu.make_async_copy(
+            upd_ref.at[pl.ds(off_big(pp), KMAX_BIG), :],
+            sup_ref.at[slot],
+            sems.at[slot],
+        ).start()
+
+    def wait(slot):
+        pltpu.make_async_copy(
+            upd_ref.at[pl.ds(0, KMAX_BIG), :], sup_ref.at[slot], sems.at[slot]
+        ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, 0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, p + 1)
+
+    wait(slot)
+    o_big = off_big(p)
+    for t in range(S):
+        q = p * S + t
+        rel = (starts_ref[q] // _ALIGN) * _ALIGN - o_big
+        sub = sup_ref[slot, pl.ds(rel, KMAX_SUB), :]
+        base = (_u32(p) * _u32(S * R_SUB)) + _u32(t * R_SUB)
+        delta = _delta_merge_free(sub, base, R_SUB, KMAX_SUB, W, INT8)
+        sl = pl.ds(t * R_SUB, R_SUB)
+        out_ref[sl, :] = blocks_ref[sl, :] | delta
+
+
+def sweep2_insert(blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8):
+    NB_, W_ = blocks.shape
+    R_DMA = R_SUB * S
+    P = NB_ // R_DMA
+    LANES = upd.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((R_DMA, W_), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((R_DMA, W_), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, KMAX_BIG, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _kernel2,
+            R_SUB=R_SUB, S=S, KMAX_SUB=KMAX_SUB, KMAX_BIG=KMAX_BIG,
+            W=W_, INT8=INT8,
+        ),
+        out_shape=jax.ShapeDtypeStruct((NB_, W_), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+    )
+    return fn(starts, upd, blocks)
+
+
+def build_stream(keys, R_sub, KMAX_big, lanes):
+    """Sorted narrow update stream + R_sub-granular partition boundaries."""
+    P_sub = NB // R_sub
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    blk = blk.astype(jnp.uint32)
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+    bs = sorted_cols[0].astype(jnp.int32)
+    bit_sorted = _unpack_positions(sorted_cols[1:], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    starts = jnp.searchsorted(
+        bs, (jnp.arange(P_sub + 1, dtype=jnp.int32) * R_sub).astype(jnp.int32)
+    ).astype(jnp.int32)
+    pad = KMAX_big + _ALIGN
+    upd = jnp.zeros((B + pad, lanes), jnp.uint32)
+    upd = upd.at[:, 0].set(
+        jnp.concatenate(
+            [bs.astype(jnp.uint32), jnp.full((pad,), NB, jnp.uint32)]
+        )
+    )
+    upd = upd.at[:B, 1 : W + 1].set(masks)
+    return starts, upd
+
+
+def check_windows(starts, S, KMAX_sub, KMAX_big):
+    """No sub-window or big window may overflow its fetch (proto-only:
+    the production port keeps the overflow chunk loop instead)."""
+    s = np.asarray(starts).astype(np.int64)
+    P_sub = len(s) - 1
+    a = (s[:-1] // _ALIGN) * _ALIGN  # aligned sub-window starts
+    sub_span = s[1:] - a  # rows each sub-window must cover
+    o_big = np.repeat((s[0:P_sub:S] // _ALIGN) * _ALIGN, S)
+    big_need = a + KMAX_sub - o_big  # KMAX_sub rows are read at offset a
+    return int(sub_span.max()), int(big_need.max())
+
+
+def run_variant(name, starts, upd, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8,
+                ref_state=None):
+    def step(state, upd, starts):
+        out = sweep2_insert(
+            state, upd, starts,
+            R_SUB=R_SUB, S=S, KMAX_SUB=KMAX_SUB, KMAX_BIG=KMAX_BIG, INT8=INT8,
+        )
+        return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB, W), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    ok = None
+    if ref_state is not None:
+        ok = bool(
+            jnp.array_equal(state[:: NB // 4096], ref_state[:: NB // 4096])
+        ) and bool(
+            jnp.array_equal(state[1 :: NB // 1024], ref_state[1 :: NB // 1024])
+        )
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    carry.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    P = NB // (R_SUB * S)
+    # blocks stream alone is 2 * NB * W * 4 bytes; faster than HBM can
+    # move it means the axon timing anomaly hit (see r_sweep_r3 notes)
+    implausible = (2 * NB * W * 4 / dt) > 900e9
+    print(
+        json.dumps(
+            {
+                "variant": name,
+                "timing_implausible": implausible,
+                "R_sub": R_SUB, "S": S, "KMAX_sub": KMAX_SUB,
+                "KMAX_big": KMAX_BIG, "lanes": int(upd.shape[1]),
+                "int8": INT8, "grid": P,
+                "ms": round(dt * 1e3, 3),
+                "us_per_grid_step": round(dt / P * 1e6, 3),
+                "keys_per_sec": round(B / dt),
+                "compile_s": round(compile_s, 1),
+                "first_pass_matches_shipping": ok,
+            }
+        ),
+        flush=True,
+    )
+    del state
+
+
+def main():
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(rng.integers(0, 256, (B, KEY_LEN), np.uint8))
+
+    # reference final state: ONE pass of the shipping kernel on the same keys
+    R0, KMAX0 = choose_params(NB, B)
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    from tpubloom.ops.sweep import apply_blocked_updates
+
+    ref_state = jax.jit(
+        lambda b, bl, bi: apply_blocked_updates(
+            b, bl, bi, jnp.ones((B,), bool), block_bits=BB, interpret=False
+        )
+    )(jnp.zeros((NB, W), jnp.uint32), blk, bit)
+    ref_state.block_until_ready()
+
+    # lanes are pinned to 128: Mosaic rejects DMA slices whose lane dim is
+    # not 128-aligned ("Slice shape along dimension 1 must be aligned to
+    # tiling (128), but is 32" — measured 2026-07-30), so a [Btot, 32]
+    # stream cannot be window-fetched. The A-floor is per-grid-step
+    # overhead, not bytes, so wide rows + big S is the attack.
+    variants = [
+        # (name, R_sub, S, lanes, int8)
+        ("wide128 R512 S1 (C repro)", 512, 1, 128, False),
+        ("wide128 R512 S4", 512, 4, 128, False),
+        ("wide128 R512 S8", 512, 8, 128, False),
+        ("wide128 R256 S16", 256, 16, 128, False),
+        ("wide128 R512 S8 int8", 512, 8, 128, True),
+        ("wide128 R256 S16 int8", 256, 16, 128, True),
+        ("wide128 R128 S32 int8", 128, 32, 128, True),
+        ("wide128 R1024 S4", 1024, 4, 128, False),
+    ]
+    built = {}
+    for name, r_sub, s, lanes, int8 in variants:
+        lam_sub = B * r_sub // NB
+        KMAX_sub = min(1024, max(16, (lam_sub + max(16, int(8 * lam_sub**0.5)) + 7) // 8 * 8))
+        lam_big = lam_sub * s
+        KMAX_big = (
+            KMAX_sub if s == 1
+            else ((lam_big + KMAX_sub + 256 + 7) // 8) * 8
+        )
+        key_ = (r_sub, KMAX_big, lanes)
+        if key_ not in built:
+            starts, upd = jax.jit(
+                lambda kk: build_stream(kk, r_sub, KMAX_big, lanes)
+            )(keys)
+            starts.block_until_ready()
+            built[key_] = (starts, upd)
+        starts, upd = built[key_]
+        sub_max, big_need = check_windows(starts, s, KMAX_sub, KMAX_big)
+        if sub_max > KMAX_sub or big_need > KMAX_big:
+            print(json.dumps({"variant": name, "skip": "window overflow",
+                              "sub_max": sub_max, "big_need": big_need}),
+                  flush=True)
+            continue
+        try:
+            run_variant(
+                name, starts, upd,
+                R_SUB=r_sub, S=s, KMAX_SUB=KMAX_sub, KMAX_BIG=KMAX_big,
+                INT8=int8, ref_state=ref_state,
+            )
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": repr(e)[:400]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

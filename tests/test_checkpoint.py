@@ -325,3 +325,70 @@ def test_scalable_async_checkpointer(tmp_path):
     g = ckpt.restore(base, sink)
     assert g.n_layers == f.n_layers and g.n_inserted == 1200
     assert g.include_batch(keys).all()
+
+
+def _strip_block_hash(tmp_path, key_name):
+    """Rewrite the newest checkpoint for ``key_name`` as a pre-block_hash
+    writer would have produced it: no block_hash key anywhere in the
+    header (base config or per-layer configs)."""
+    import json
+    import pathlib
+
+    path = max(pathlib.Path(tmp_path).glob(f"{key_name}.*.ckpt"))
+    blob = path.read_bytes()
+    header, payload = ckpt._deserialize(blob)
+    header["config"].pop("block_hash", None)
+    for d in header.get("scalable", {}).get("layer_configs", []):
+        d.pop("block_hash", None)
+    hdr = json.dumps(header).encode()
+    path.write_bytes(ckpt.MAGIC + len(hdr).to_bytes(8, "little") + hdr + payload)
+
+
+@pytest.mark.parametrize("block_bits", [0, 512])
+def test_scalable_restore_pre_block_hash_header(tmp_path, block_bits):
+    """Checkpoints written before block_hash existed must keep restoring:
+    absent field means the layer was built with the AP in-block spec
+    (blocked) / "" (flat), and _load_layers must normalize the stored
+    dicts through FilterConfig.from_dict before comparing (ADVICE r2
+    high finding — strict dict equality rejected every legacy stack)."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    base = FilterConfig(
+        m=max(64, block_bits), k=1, key_len=16, key_name="scale-legacy",
+        block_bits=block_bits, block_hash="ap" if block_bits else "auto",
+    )
+    f = ScalableBloomFilter(300, 0.01, config=base)
+    rng = np.random.default_rng(11)
+    keys = _rand_keys(1000, rng)
+    f.insert_batch(keys)
+    assert f.n_layers >= 2
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    _strip_block_hash(tmp_path, "scale-legacy")
+    g = ckpt.restore(base, sink)
+    assert isinstance(g, ScalableBloomFilter)
+    assert g.n_layers == f.n_layers
+    assert g.include_batch(keys).all()
+    probe = _rand_keys(2000, np.random.default_rng(12))
+    np.testing.assert_array_equal(f.include_batch(probe), g.include_batch(probe))
+
+
+def test_scalable_legacy_header_rejects_chunk_base(tmp_path):
+    """The same legacy blocked checkpoint must NOT restore into a base
+    config using the chunk spec — and the refusal must come from the
+    early base-identity check (block_hash is in IDENTITY_FIELDS_SCALABLE,
+    ADVICE r2 medium), not a late layer-dict mismatch."""
+    from tpubloom.scalable import ScalableBloomFilter
+
+    base_ap = FilterConfig(
+        m=512, k=1, key_len=16, key_name="scale-legacy2",
+        block_bits=512, block_hash="ap",
+    )
+    f = ScalableBloomFilter(300, 0.01, config=base_ap)
+    f.insert_batch(_rand_keys(500, np.random.default_rng(13)))
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    _strip_block_hash(tmp_path, "scale-legacy2")
+    base_chunk = base_ap.replace(block_hash="chunk")
+    with pytest.raises(ValueError, match="mismatch on base block_hash"):
+        ckpt.restore(base_chunk, sink)

@@ -1,0 +1,107 @@
+"""Flat -> blocked migration tool tests (VERDICT r2 next-round #4: the
+flat layout's explicit compat-only stance needs a tested migration path)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpubloom import BloomFilter, FilterConfig
+from tpubloom import checkpoint as ckpt
+from tpubloom.filter import BlockedBloomFilter
+from tpubloom.migrate import migrate_checkpoint
+
+
+def _rand_keys(n, rng):
+    return [rng.bytes(16) for _ in range(n)]
+
+
+@pytest.fixture
+def flat_ckpt(tmp_path):
+    cfg = FilterConfig(m=1 << 20, k=5, key_len=16, key_name="compat")
+    rng = np.random.default_rng(0)
+    keys = _rand_keys(3000, rng)
+    f = BloomFilter(cfg)
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    return cfg, sink, keys
+
+
+def test_migrate_roundtrip(flat_ckpt):
+    cfg, sink, keys = flat_ckpt
+    summary = migrate_checkpoint(
+        sink, iter(keys), src_config=cfg, batch_size=512
+    )
+    assert summary["migrated"] == len(keys) and summary["missing"] == 0
+    dst_config = FilterConfig.from_dict(summary["dst_config"])
+    assert dst_config.key_name == "compat.blocked"
+    g = ckpt.restore(dst_config, sink)
+    assert isinstance(g, BlockedBloomFilter)
+    assert g.include_batch(keys).all(), "migrated filter lost keys"
+    rng = np.random.default_rng(1)
+    assert g.include_batch(_rand_keys(3000, rng)).mean() < 0.01
+
+
+def test_migrate_rejects_foreign_stream(flat_ckpt):
+    """A stream that is not the filter's source must fail fast (the
+    migrated filter would otherwise silently answer differently)."""
+    cfg, sink, keys = flat_ckpt
+    rng = np.random.default_rng(2)
+    bad = keys[:100] + _rand_keys(50, rng)
+    with pytest.raises(ValueError, match="not this filter's source"):
+        migrate_checkpoint(sink, iter(bad), src_config=cfg, batch_size=64)
+
+
+def test_migrate_lenient_superset(flat_ckpt):
+    cfg, sink, keys = flat_ckpt
+    rng = np.random.default_rng(3)
+    extra = _rand_keys(40, rng)
+    summary = migrate_checkpoint(
+        sink, iter(keys + extra), src_config=cfg, strict=False,
+        dst_key_name="compat.blk2",
+    )
+    # FPR can leak a few extras in; every true key must migrate
+    assert summary["migrated"] >= len(keys)
+    assert summary["missing"] + summary["migrated"] == len(keys) + len(extra)
+    dst_config = FilterConfig.from_dict(summary["dst_config"])
+    g = ckpt.restore(dst_config, sink)
+    assert g.include_batch(keys).all()
+
+
+def test_migrate_rejects_non_flat_source(tmp_path):
+    sink = ckpt.FileSink(str(tmp_path))
+    blocked = FilterConfig(m=1 << 20, k=5, block_bits=512)
+    with pytest.raises(ValueError, match="flat single-device"):
+        migrate_checkpoint(sink, iter([]), src_config=blocked)
+
+
+def test_migrate_cli(flat_ckpt, tmp_path):
+    cfg, sink, keys = flat_ckpt
+    hexfile = tmp_path / "keys.txt"
+    # newline-delimited: hex-encode (raw random bytes may contain \n)
+    hexkeys = [k.hex().encode() for k in keys]
+    hexfile.write_bytes(b"\n".join(hexkeys) + b"\n")
+    # the hex strings are what we migrate — insert them into a fresh flat
+    # filter so the CLI's stream matches its source filter (hex doubles
+    # the length, so this filter uses key_len=32)
+    cli_cfg = cfg.replace(key_name="clikeys", key_len=32)
+    f = BloomFilter(cli_cfg)
+    f.insert_batch(hexkeys)
+    ckpt.save(f, sink)
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "tpubloom.migrate",
+            "--src", str(sink.directory), "--key-name", "clikeys",
+            "--m", str(cfg.m), "--k", str(cfg.k), "--key-len", "32",
+            "--keys", str(hexfile),
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json
+
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["migrated"] == len(hexkeys)

@@ -230,3 +230,52 @@ def test_scalable_bare_attach_and_policy_drift(server):
     # scalable insert replay safety: inserts on scalable filters are never
     # auto-retried (layer fill counts are not idempotent)
     assert client._maybe_nonidempotent_insert("sc-b")
+
+
+def test_sharded_counting_filter_via_server(server, tmp_path):
+    """configs 4 x 5 over the L4 boundary: create a sharded counting
+    filter, insert/delete/query, restart-restore as the SAME class (the
+    server's CreateFilter routing and checkpoint.restore must agree)."""
+    client, service, _ = server
+    cfg = {
+        "m": 1 << 16, "k": 4, "key_len": 16, "shards": 8, "counting": True,
+        "block_bits": 512,
+    }
+    client.create_filter("shcnt", config=cfg)
+    from tpubloom.parallel.sharded import ShardedBloomFilter
+
+    assert isinstance(service._filters["shcnt"].filter, ShardedBloomFilter)
+    rng = np.random.default_rng(5)
+    keys = _rand_keys(500, rng)
+    client.insert_batch("shcnt", keys)
+    client.delete_batch("shcnt", keys[:200])
+    assert client.include_batch("shcnt", keys[200:]).all()
+    assert client.include_batch("shcnt", keys[:200]).mean() < 0.05
+    client.checkpoint("shcnt")
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(str(tmp_path))
+    )
+    srv2, port2 = build_server(service2, "127.0.0.1:0")
+    srv2.start()
+    try:
+        c2 = BloomClient(f"127.0.0.1:{port2}")
+        c2.wait_ready()
+        c2.create_filter("shcnt", config=cfg)  # restore-on-create
+        assert isinstance(
+            service2._filters["shcnt"].filter, ShardedBloomFilter
+        )
+        assert c2.include_batch("shcnt", keys[200:]).all()
+        c2.delete_batch("shcnt", keys[200:300])  # restored: delete works
+        assert c2.include_batch("shcnt", keys[300:]).all()
+        c2.close()
+    finally:
+        srv2.stop(grace=None)
+
+
+def test_delete_on_sharded_plain_filter_rejected(server):
+    client, _, _ = server
+    client.create_filter(
+        "shplain", config={"m": 1 << 16, "k": 4, "key_len": 16, "shards": 8}
+    )
+    with pytest.raises(BloomServiceError, match="UNSUPPORTED"):
+        client.delete_batch("shplain", [b"x"])

@@ -17,23 +17,39 @@ def _rand_keys(n, rng, nbytes=16):
 
 
 class ShardedCPURef:
-    """Oracle: n independent pure-NumPy CPU filters + the routing hash
+    """Oracle: n independent per-shard reference filters + the routing hash
     (use_native pinned False — the ground truth must not be the C++ path).
-    Handles both layouts via the per-shard filter class."""
+    Handles all four layouts via the per-shard filter class; the blocked
+    counting oracle is the single-device class (whose scatter fallback is
+    itself oracle-pinned in test_counting_blocked)."""
 
     def __init__(self, config):
         self.config = config
         local = FilterConfig(
             m=config.m_per_shard, k=config.k, seed=config.seed,
             key_len=config.key_len, block_bits=config.block_bits,
+            counting=config.counting,
         )
-        if config.block_bits:
+        if config.counting and config.block_bits:
+            from tpubloom.filter import BlockedCountingBloomFilter
+
+            make = lambda: BlockedCountingBloomFilter(
+                local.replace(insert_path="scatter")
+            )
+        elif config.counting:
+            make = lambda: CPUBloomFilter(local, use_native=False)
+        elif config.block_bits:
             from tpubloom.cpu_ref import CPUBlockedBloomFilter
 
             make = lambda: CPUBlockedBloomFilter(local, use_native=False)
         else:
             make = lambda: CPUBloomFilter(local, use_native=False)
         self.filters = [make() for _ in range(config.shards)]
+
+    def delete_batch(self, keys):
+        routes = self._route(keys)
+        for key, r in zip(keys, routes):
+            self.filters[r].delete(key)
 
     def _route(self, keys):
         ks, ls = pack_keys(keys, self.config.key_len)
@@ -233,3 +249,106 @@ def test_blocked_sweep_path_in_shard_map():
     g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
     g.insert_batch(keys)
     np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+# -- counting variants over the mesh (BASELINE configs 4 x 5) ----------------
+
+
+@pytest.fixture(scope="module")
+def cnt_cfg8():
+    return FilterConfig(
+        m=1 << 20, k=5, key_len=16, shards=8, counting=True
+    )
+
+
+@pytest.fixture(scope="module")
+def blkcnt_cfg8():
+    return FilterConfig(
+        m=1 << 20, k=5, key_len=16, shards=8, counting=True, block_bits=512
+    )
+
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_counting_roundtrip_with_delete(layout, cnt_cfg8, blkcnt_cfg8):
+    cfg = cnt_cfg8 if layout == "flat" else blkcnt_cfg8
+    rng = np.random.default_rng(20)
+    keys = _rand_keys(2000, rng)
+    f = ShardedBloomFilter(cfg)
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    f.delete_batch(keys[:1000])
+    assert f.include_batch(keys[1000:]).all(), "kept keys must stay present"
+    assert f.include_batch(keys[:1000]).mean() < 0.01, "deleted keys linger"
+    assert f.include_batch(_rand_keys(2000, rng)).mean() < 0.01
+
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_counting_parity_vs_oracle(layout, cnt_cfg8, blkcnt_cfg8):
+    """Mesh counting implementation == compose-n-reference-filters oracle,
+    counter for counter, including after deletes."""
+    cfg = cnt_cfg8 if layout == "flat" else blkcnt_cfg8
+    rng = np.random.default_rng(21)
+    keys = _rand_keys(500, rng) + [b"", b"a", b"sharded-key"]
+    f, o = ShardedBloomFilter(cfg), ShardedCPURef(cfg)
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    f.delete_batch(keys[:200])
+    o.delete_batch(keys[:200])
+    dev = np.asarray(f.words)  # [shards, ...local words]
+    for s in range(cfg.shards):
+        np.testing.assert_array_equal(
+            dev[s].reshape(-1),
+            np.asarray(o.filters[s].words).reshape(-1),
+            err_msg=f"shard {s} counters differ",
+        )
+    probe = keys + _rand_keys(500, rng)
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
+
+
+def test_counting_sweep_path_in_shard_map():
+    """Forced counting sweep (Pallas interpret mode inside shard_map on the
+    fake 8-device mesh) matches the scatter path counter for counter,
+    including deletes — guards the per-device counting sweep that runs on
+    real TPUs (VERDICT r2 next-round #3)."""
+    cfg = FilterConfig(
+        m=1 << 25, k=5, key_len=16, block_bits=512, shards=8,
+        counting=True, insert_path="sweep",
+    )
+    rng = np.random.default_rng(22)
+    keys = [rng.bytes(16) for _ in range(512)]
+    f = ShardedBloomFilter(cfg, mesh=make_mesh(8))
+    f.insert_batch(keys)
+    f.delete_batch(keys[:200])
+    assert f.include_batch(keys[200:]).all()
+    g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
+    g.insert_batch(keys)
+    g.delete_batch(keys[:200])
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_counting_checkpoint_restore(layout, cnt_cfg8, blkcnt_cfg8, tmp_path):
+    from tpubloom import checkpoint as ckpt
+
+    cfg = (cnt_cfg8 if layout == "flat" else blkcnt_cfg8).replace(
+        key_name=f"cnt-sharded-{layout}"
+    )
+    rng = np.random.default_rng(23)
+    keys = _rand_keys(600, rng)
+    f = ShardedBloomFilter(cfg)
+    f.insert_batch(keys)
+    f.delete_batch(keys[:100])
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    g = ckpt.restore(cfg, sink)
+    assert isinstance(g, ShardedBloomFilter)
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    assert g.include_batch(keys[100:]).all()
+    g.delete_batch(keys[100:200])  # restored filter still supports delete
+    assert g.include_batch(keys[200:]).all()
+
+
+def test_counting_delete_requires_counting(cfg8):
+    f = ShardedBloomFilter(cfg8)
+    with pytest.raises(ValueError, match="counting"):
+        f.delete_batch([b"x"])

@@ -44,7 +44,9 @@ MAGIC = b"TPUBLOOM1\n"
 #: Base-config identity for scalable checkpoints: the template's m/k are
 #: placeholders (each layer derives its own from the growth policy), so
 #: only the fields every layer inherits participate.
-IDENTITY_FIELDS_SCALABLE = ("seed", "counting", "shards", "block_bits")
+IDENTITY_FIELDS_SCALABLE = (
+    "seed", "counting", "shards", "block_bits", "block_hash"
+)
 
 _CKPT_RE = re.compile(r"^(?P<name>.+)\.(?P<seq>\d{12,})\.ckpt$")
 
@@ -364,7 +366,13 @@ def restore(
             f"requested={getattr(config, field)}"
         )
     words = payload_to_words(config, header, payload)
-    if config.counting and config.block_bits:
+    if config.shards > 1:
+        from tpubloom.parallel.sharded import ShardedBloomFilter
+        import jax
+
+        f = ShardedBloomFilter(config)
+        f.words = jax.device_put(words.reshape(f.words.shape), f.sharding)
+    elif config.counting and config.block_bits:
         from tpubloom.filter import BlockedCountingBloomFilter
         import jax.numpy as jnp
 
@@ -379,17 +387,6 @@ def restore(
         import jax.numpy as jnp
 
         f.words = jnp.asarray(words)
-    elif config.shards > 1:
-        from tpubloom.parallel.sharded import ShardedBloomFilter
-        import jax
-
-        f = ShardedBloomFilter(config)
-        shape = (
-            (config.shards, config.n_blocks_per_shard, config.words_per_block)
-            if config.block_bits
-            else (config.shards, config.n_words_per_shard)
-        )
-        f.words = jax.device_put(words.reshape(shape), f.sharding)
     elif config.block_bits:
         from tpubloom.filter import BlockedBloomFilter
         import jax.numpy as jnp

@@ -195,12 +195,7 @@ def make_blocked_counting_query_fn(config: FilterConfig):
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
-        rows = blocks[blk]  # [B, W]
-        word = (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k] in [0, W)
-        nib = (cpos & jnp.uint32(7)) * jnp.uint32(4)
-        vals = jnp.take_along_axis(rows, word, axis=-1)
-        cnt = (vals >> nib) & jnp.uint32(15)
-        return jnp.all(cnt > 0, axis=-1)
+        return counting.blocked_counting_membership(blocks, blk, cpos)
 
     return query
 

@@ -135,8 +135,22 @@ def hash_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, m: int
     )
 
 
+def _check_chunk_pool(block_bits: int, k: int, block_hash: str) -> None:
+    """The chunk spec slices k positions out of the 96-bit (h_b, g_a, g_b)
+    pool; the C++ side indexes pool[3] unchecked, so validate here exactly
+    like cpu_ref.blocked_positions_np / FilterConfig do."""
+    if block_hash == "chunk":
+        nb = (block_bits - 1).bit_length()
+        if k * nb > 96:
+            raise ValueError(
+                f"block_hash='chunk' needs k*log2(block_bits) <= 96 "
+                f"(k={k}, {nb} bits/position) — use 'ap'"
+            )
+
+
 def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int, block_hash: str = "ap") -> None:
     """Fused blocked-spec insert into ``uint32[n_blocks, W]`` (in place)."""
+    _check_chunk_pool(block_bits, k, block_hash)
     lib = _load()
     assert lib is not None
     keys = np.ascontiguousarray(keys, dtype=np.uint8)
@@ -150,6 +164,7 @@ def blocked_insert(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_b
 
 
 def blocked_query(words: np.ndarray, keys: np.ndarray, lens: np.ndarray, *, n_blocks: int, block_bits: int, k: int, seed: int, block_hash: str = "ap") -> np.ndarray:
+    _check_chunk_pool(block_bits, k, block_hash)
     lib = _load()
     assert lib is not None
     keys = np.ascontiguousarray(keys, dtype=np.uint8)

@@ -92,3 +92,21 @@ def counter_get(words: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
 def counting_membership(words: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
     """``bool[B]``: all k counters of each key are nonzero (pos is [B, k])."""
     return jnp.all(counter_get(words, pos) > 0, axis=-1)
+
+
+def blocked_counting_membership(
+    blocks: jnp.ndarray, blk: jnp.ndarray, cpos: jnp.ndarray
+) -> jnp.ndarray:
+    """``bool[B]`` blocked-counting membership: one row gather per key +
+    all-counters-nonzero over the in-block positions. The single source of
+    the 4-bit (word = c >> 3, nibble = c & 7) unpacking shared by the
+    single-chip and sharded query paths.
+
+    ``blocks uint32[NB, W]``, ``blk int32[B]``, ``cpos uint32[B, k]``.
+    """
+    rows = blocks[blk]  # [B, W]
+    word = (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k] in [0, W)
+    nib = (cpos & jnp.uint32(7)) * jnp.uint32(4)
+    vals = jnp.take_along_axis(rows, word, axis=-1)
+    cnt = (vals >> nib) & _u32(15)
+    return jnp.all(cnt > 0, axis=-1)

@@ -663,6 +663,61 @@ def sweep_counter_update(
     return fn(starts, updates, blocks)
 
 
+def apply_counter_updates(
+    blocks: jnp.ndarray,
+    blk: jnp.ndarray,
+    cpos: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    counters_per_block: int,
+    k: int,
+    increment: bool,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Apply each valid key's blocked-counting update to ``blocks`` via the
+    counting sweep (saturating +1 / flooring -1 per counter occurrence).
+
+    The kernel-facing entry point shared by the single-chip path and the
+    sharded per-device path (which routes keys first and passes
+    device-local row ids). ``blk int32[B]`` block rows, ``cpos
+    uint32[B, k]`` in-block counter positions, ``valid bool[B]``; invalid
+    keys are dropped. Requires ``k <= 15`` (per-key multiplicity must fit
+    the 4-bit stream nibbles).
+    """
+    nb, w = blocks.shape
+    B = blk.shape[0]
+    cpb = counters_per_block
+    R, KMAX = choose_params(nb, B)
+    if nb % R != 0 or w + 1 > 128:
+        raise ValueError(
+            f"sweep counter update does not support this shape "
+            f"(n_blocks={nb}, R={R}, words_per_block={w})"
+        )
+    P = nb // R
+    interp = jax.default_backend() == "cpu" if interpret is None else interpret
+    blk = jnp.where(valid, blk, nb)
+    cols, nbits, packed = _pack_positions(cpos, cpb, k)
+    sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+    bs = sorted_cols[0]
+    cpos_s = _unpack_positions(sorted_cols[1:], cpb, k, nbits, packed)
+    # per-key multiplicity of each counter, packed 4 bits per nibble
+    # in the counter-storage (word, nibble) layout: counter c lives
+    # in word c >> 3, nibble c & 7 — multiplicity <= k <= 15
+    planes = jnp.zeros((B, cpb), jnp.uint32)
+    iota_c = lax.broadcasted_iota(jnp.uint32, (B, cpb), 1)
+    for i in range(k):
+        planes = planes + (cpos_s[:, i : i + 1] == iota_c).astype(jnp.uint32)
+    pw = planes.reshape(B, w, 8)
+    shifts = (jnp.arange(8, dtype=jnp.uint32) * 4)[None, None, :]
+    cnt_words = jnp.sum(pw << shifts, axis=2, dtype=jnp.uint32)  # [B, W]
+    starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
+    upd = upd.at[:B, 1 : w + 1].set(cnt_words)
+    return sweep_counter_update(
+        blocks, upd, starts,
+        R=R, KMAX=KMAX, increment=increment, interpret=interp,
+    )
+
+
 def make_sweep_counter_fn(
     config, *, increment: bool, interpret: bool | None = None
 ):
@@ -672,48 +727,19 @@ def make_sweep_counter_fn(
     counting kernel applied at positions ``blk * counters_per_block + c``
     (tpubloom.filter.make_blocked_counter_fn's fallback path).
     """
-    nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
+    nb, cpb = config.n_blocks, config.counters_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
 
     def update(blocks, keys_u8, lengths):
-        B = keys_u8.shape[0]
-        R, KMAX = choose_params(nb, B)
-        if nb % R != 0 or w + 1 > 128:
-            raise ValueError(
-                f"sweep counter update does not support this shape "
-                f"(n_blocks={nb}, R={R}, words_per_block={w})"
-            )
-        P = nb // R
-        interp = (
-            jax.default_backend() == "cpu" if interpret is None else interpret
-        )
         valid = lengths >= 0
         blk, cpos = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
-        blk = jnp.where(valid, blk, nb)
-        cols, nbits, packed = _pack_positions(cpos, cpb, k)
-        sorted_cols = lax.sort((blk,) + cols, num_keys=1)
-        bs = sorted_cols[0]
-        cpos_s = _unpack_positions(sorted_cols[1:], cpb, k, nbits, packed)
-        # per-key multiplicity of each counter, packed 4 bits per nibble
-        # in the counter-storage (word, nibble) layout: counter c lives
-        # in word c >> 3, nibble c & 7 — multiplicity <= k = {k} <= 15
-        planes = jnp.zeros(
-            (B, cpb), jnp.uint32
-        )
-        iota_c = lax.broadcasted_iota(jnp.uint32, (B, cpb), 1)
-        for i in range(k):
-            planes = planes + (cpos_s[:, i : i + 1] == iota_c).astype(jnp.uint32)
-        pw = planes.reshape(B, w, 8)
-        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4)[None, None, :]
-        cnt_words = jnp.sum(pw << shifts, axis=2, dtype=jnp.uint32)  # [B, W]
-        starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
-        upd = upd.at[:B, 1 : w + 1].set(cnt_words)
-        return sweep_counter_update(
-            blocks, upd, starts,
-            R=R, KMAX=KMAX, increment=increment, interpret=interp,
+        return apply_counter_updates(
+            blocks, blk, cpos, valid,
+            counters_per_block=cpb, k=k, increment=increment,
+            interpret=interpret,
         )
 
     return update

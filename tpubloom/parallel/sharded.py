@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpubloom.config import FilterConfig
 from tpubloom.filter import _FilterBase
-from tpubloom.ops import bitops, blocked, hashing
+from tpubloom.ops import bitops, blocked, counting, hashing
 from tpubloom.utils.packing import redis_bitmap_to_words, words_to_redis_bitmap
 
 AXIS = "shards"
@@ -64,6 +64,38 @@ def make_mesh(n_shards: int, devices: Optional[Sequence[jax.Device]] = None) -> 
     return Mesh(np.array(use), (AXIS,))
 
 
+def _route_local(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
+    """The one routing decision every sharded op shares: hash the
+    replicated batch with the routing hash, map the owning shard to this
+    device's local row space. Returns ``(local_row[B], owned[B],
+    lens[B])`` — ``owned`` marks keys routed to one of this device's
+    shard rows (False for batch padding); ``local_row`` is meaningful
+    only where owned (callers clamp with ``jnp.where(owned, ...)``)."""
+    dev = jax.lax.axis_index(AXIS)
+    lens = jnp.maximum(lengths, 0)
+    route = hashing.route_shards(
+        keys_u8, lens, n_shards=config.shards, seed=config.seed
+    ).astype(jnp.int32)
+    local_row = route - dev * shards_per_dev
+    owned = (local_row >= 0) & (local_row < shards_per_dev) & (lengths >= 0)
+    return local_row, owned, lens
+
+
+def _use_local_sweep(config: FilterConfig, local_rows: int, batch: int) -> bool:
+    """Resolve config.insert_path for the per-device hot loop (the local
+    row count, not the global filter, decides sweep applicability)."""
+    from tpubloom.ops import sweep
+
+    if config.insert_path == "sweep":
+        return True
+    return config.insert_path == "auto" and (
+        sweep.auto_insert_path(
+            jax.default_backend(), local_rows, batch, config.words_per_block
+        )
+        == "sweep"
+    )
+
+
 def _routed_positions(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
     """Shared insert/query preamble: hash the replicated batch, route each
     key, and translate to this device's local (word, bit) coordinates.
@@ -74,18 +106,12 @@ def _routed_positions(config: FilterConfig, shards_per_dev: int, keys_u8, length
     ``owned`` — scatter drops them, gather verdicts are ignored).
     """
     m_local = config.m_per_shard
-    dev = jax.lax.axis_index(AXIS)
-    lens = jnp.maximum(lengths, 0)
-    route = hashing.route_shards(
-        keys_u8, lens, n_shards=config.shards, seed=config.seed
-    ).astype(jnp.int32)
+    local_row, owned, lens = _route_local(config, shards_per_dev, keys_u8, lengths)
     ph, pl = hashing.positions(
         keys_u8, lens, m=m_local, k=config.k, seed=config.seed
     )
     word, bit = hashing.split_word_bit(ph, pl)
     # Global->local row: shard r is row (r - dev*shards_per_dev) here.
-    local_row = route - dev * shards_per_dev
-    owned = (local_row >= 0) & (local_row < shards_per_dev) & (lengths >= 0)
     word = word + jnp.where(owned, local_row, 0)[:, None] * (m_local // 32)
     return word, bit, owned
 
@@ -150,19 +176,13 @@ def _routed_blocks(
     ``[shards_per_dev * n_blocks_local]`` row space (clamped to 0 for
     unowned keys)."""
     nbl = config.n_blocks_per_shard
-    dev = jax.lax.axis_index(AXIS)
-    lens = jnp.maximum(lengths, 0)
-    route = hashing.route_shards(
-        keys_u8, lens, n_shards=config.shards, seed=config.seed
-    ).astype(jnp.int32)
+    local_row, owned, lens = _route_local(config, shards_per_dev, keys_u8, lengths)
     blk, bit = blocked.block_positions(
         keys_u8, lens,
         n_blocks=nbl, block_bits=config.block_bits, k=config.k,
         seed=config.seed, block_hash=config.block_hash,
     )
     masks = blocked.build_masks(bit, config.words_per_block)
-    local_row = route - dev * shards_per_dev
-    owned = (local_row >= 0) & (local_row < shards_per_dev) & (lengths >= 0)
     blk = blk + jnp.where(owned, local_row, 0) * nbl
     if want_bit:
         return blk, masks, owned, bit
@@ -185,17 +205,7 @@ def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
             config, shards_per_dev, keys_u8, lengths, want_bit=True
         )
         flat = blocks_block.reshape(-1, config.words_per_block)
-        use_sweep = config.insert_path == "sweep" or (
-            config.insert_path == "auto"
-            and sweep.auto_insert_path(
-                jax.default_backend(),
-                local_rows,
-                keys_u8.shape[0],
-                config.words_per_block,
-            )
-            == "sweep"
-        )
-        if use_sweep:
+        if _use_local_sweep(config, local_rows, keys_u8.shape[0]):
             flat = sweep.apply_blocked_updates(
                 flat, blk, bit, owned, block_bits=config.block_bits
             )
@@ -235,6 +245,164 @@ def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
     )
 
 
+# -- counting variant (configs 4 x 5: sharded counting filter array) ---------
+
+
+def _routed_counter_positions(config: FilterConfig, shards_per_dev, keys_u8, lengths):
+    """Flat-counting preamble: route keys, then device-local counter
+    positions. ``m`` counts COUNTERS; shard s owns counters
+    ``[s*m_local, (s+1)*m_local)``. Returns ``(pos[B, k], owned[B])`` with
+    ``pos`` in this device's ``[0, shards_per_dev*m_local)`` local space
+    (row 0 for unowned keys — callers mask)."""
+    m_local = config.m_per_shard
+    local_row, owned, lens = _route_local(config, shards_per_dev, keys_u8, lengths)
+    _, pl = hashing.positions(
+        keys_u8, lens, m=m_local, k=config.k, seed=config.seed
+    )
+    pos = pl.astype(jnp.int32) + jnp.where(owned, local_row, 0)[:, None] * m_local
+    return pos, owned
+
+
+def make_sharded_counter_fn(config: FilterConfig, mesh: Mesh, *, increment: bool):
+    """Flat-counting sharded update: ``(words[S, Wc], keys, lengths) ->
+    words`` with saturating +1 (insert) / flooring -1 (delete) on this
+    device's packed 4-bit counters — same one-clamp-per-batch semantics
+    as :func:`tpubloom.ops.counting.counter_update` (the ground truth)."""
+    shards_per_dev = config.shards // mesh.devices.size
+
+    def local_update(words_block, keys_u8, lengths):
+        pos, owned = _routed_counter_positions(
+            config, shards_per_dev, keys_u8, lengths
+        )
+        valid_k = jnp.broadcast_to(owned[:, None], pos.shape)
+        flat = counting.counter_update(
+            words_block.reshape(-1), pos.ravel(), valid_k.ravel(),
+            increment=increment,
+        )
+        return flat.reshape(words_block.shape)
+
+    return shard_map(
+        local_update,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(), P()),
+        out_specs=P(AXIS, None),
+    )
+
+
+def make_sharded_counting_query_fn(config: FilterConfig, mesh: Mesh):
+    """Flat-counting sharded membership: owners test all-k-counters
+    nonzero, psum-OR over ICI assembles the replicated verdict."""
+    shards_per_dev = config.shards // mesh.devices.size
+
+    def local_query(words_block, keys_u8, lengths):
+        pos, owned = _routed_counter_positions(
+            config, shards_per_dev, keys_u8, lengths
+        )
+        verdict = counting.counting_membership(words_block.reshape(-1), pos)
+        one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
+        hit = jax.lax.psum(one_hot, AXIS)
+        return hit > 0
+
+    return shard_map(
+        local_query,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(), P()),
+        out_specs=P(),
+    )
+
+
+def _routed_counter_blocks(config: FilterConfig, shards_per_dev, keys_u8, lengths):
+    """Blocked-counting preamble: route keys to shards, then to this
+    device's local block rows. Returns ``(blk[B], cpos[B, k], owned[B])``
+    with ``blk`` in the device-local ``[shards_per_dev * n_blocks_local]``
+    row space and ``cpos`` the in-block counter positions."""
+    nbl = config.n_blocks_per_shard
+    local_row, owned, lens = _route_local(config, shards_per_dev, keys_u8, lengths)
+    blk, cpos = blocked.block_positions(
+        keys_u8, lens,
+        n_blocks=nbl, block_bits=config.counters_per_block, k=config.k,
+        seed=config.seed, block_hash=config.block_hash,
+    )
+    blk = blk + jnp.where(owned, local_row, 0) * nbl
+    return blk, cpos, owned
+
+
+def make_sharded_blocked_counter_fn(
+    config: FilterConfig, mesh: Mesh, *, increment: bool
+):
+    """Blocked-counting sharded update; on TPU the per-device hot loop is
+    the Pallas counting sweep (``sweep.apply_counter_updates`` inside
+    shard_map), elsewhere the sorted-scan flat-counting kernel on the
+    raveled local array — bit-identical results either way."""
+    shards_per_dev = config.shards // mesh.devices.size
+    local_rows = shards_per_dev * config.n_blocks_per_shard
+    cpb = config.counters_per_block
+
+    def local_update(blocks_block, keys_u8, lengths):
+        from tpubloom.ops import sweep
+
+        blk, cpos, owned = _routed_counter_blocks(
+            config, shards_per_dev, keys_u8, lengths
+        )
+        flat = blocks_block.reshape(-1, config.words_per_block)
+        use_sweep = _use_local_sweep(config, local_rows, keys_u8.shape[0])
+        if use_sweep and config.k > 15:
+            if config.insert_path == "sweep":
+                # match the single-chip contract (filter.py): a forced
+                # sweep must not silently run the scatter path
+                raise ValueError(
+                    "counting sweep supports k <= 15 — use "
+                    "insert_path='scatter'"
+                )
+            use_sweep = False
+        if use_sweep:
+            flat = sweep.apply_counter_updates(
+                flat, blk, cpos, owned,
+                counters_per_block=cpb, k=config.k, increment=increment,
+            )
+            return flat.reshape(blocks_block.shape)
+        gpos = (blk[:, None] * cpb + cpos.astype(jnp.int32)).astype(jnp.int32)
+        valid_k = jnp.broadcast_to(owned[:, None], gpos.shape)
+        out = counting.counter_update(
+            flat.reshape(-1), gpos.ravel(), valid_k.ravel(),
+            increment=increment,
+        )
+        return out.reshape(blocks_block.shape)
+
+    return shard_map(
+        local_update,
+        mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(), P()),
+        out_specs=P(AXIS, None, None),
+        # pallas_call outputs carry no vma metadata (see blocked insert)
+        check_vma=False,
+    )
+
+
+def make_sharded_blocked_counting_query_fn(config: FilterConfig, mesh: Mesh):
+    """Blocked-counting sharded membership: one local row gather per owned
+    key + all-counters-nonzero, psum-OR assembly."""
+    shards_per_dev = config.shards // mesh.devices.size
+    cpb = config.counters_per_block
+
+    def local_query(blocks_block, keys_u8, lengths):
+        blk, cpos, owned = _routed_counter_blocks(
+            config, shards_per_dev, keys_u8, lengths
+        )
+        flat = blocks_block.reshape(-1, config.words_per_block)
+        verdict = counting.blocked_counting_membership(flat, blk, cpos)
+        one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
+        hit = jax.lax.psum(one_hot, AXIS)
+        return hit > 0
+
+    return shard_map(
+        local_query,
+        mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(), P()),
+        out_specs=P(),
+    )
+
+
 class ShardedBloomFilter(_FilterBase):
     """Filter array over a device mesh (config 5). API-compatible with
     :class:`tpubloom.filter.BloomFilter`."""
@@ -245,10 +413,10 @@ class ShardedBloomFilter(_FilterBase):
         mesh: Optional[Mesh] = None,
         devices: Optional[Sequence[jax.Device]] = None,
     ):
-        if config.counting:
-            raise ValueError("sharded counting filters not yet supported")
         if config.shards < 2:
             raise ValueError("ShardedBloomFilter needs config.shards >= 2")
+        if config.counting and config.m >= (1 << 31):
+            raise ValueError("counting filters support m < 2^31")
         self.mesh = mesh if mesh is not None else make_mesh(config.shards, devices)
         if config.shards % self.mesh.devices.size != 0:
             raise ValueError(
@@ -256,7 +424,51 @@ class ShardedBloomFilter(_FilterBase):
                 f"{self.mesh.devices.size}"
             )
         super().__init__(config, 0)  # words set below with explicit sharding
-        if config.block_bits:
+        if config.counting and config.block_bits:
+            self.sharding = NamedSharding(self.mesh, P(AXIS, None, None))
+            self.words = jax.device_put(
+                jnp.zeros(
+                    (
+                        config.shards,
+                        config.n_blocks_per_shard,
+                        config.words_per_block,
+                    ),
+                    jnp.uint32,
+                ),
+                self.sharding,
+            )
+            self._insert = jax.jit(
+                make_sharded_blocked_counter_fn(config, self.mesh, increment=True),
+                donate_argnums=0,
+            )
+            self._delete = jax.jit(
+                make_sharded_blocked_counter_fn(config, self.mesh, increment=False),
+                donate_argnums=0,
+            )
+            self._query = jax.jit(
+                make_sharded_blocked_counting_query_fn(config, self.mesh)
+            )
+        elif config.counting:
+            self.sharding = NamedSharding(self.mesh, P(AXIS, None))
+            self.words = jax.device_put(
+                jnp.zeros(
+                    (config.shards, config.n_counter_words // config.shards),
+                    jnp.uint32,
+                ),
+                self.sharding,
+            )
+            self._insert = jax.jit(
+                make_sharded_counter_fn(config, self.mesh, increment=True),
+                donate_argnums=0,
+            )
+            self._delete = jax.jit(
+                make_sharded_counter_fn(config, self.mesh, increment=False),
+                donate_argnums=0,
+            )
+            self._query = jax.jit(
+                make_sharded_counting_query_fn(config, self.mesh)
+            )
+        elif config.block_bits:
             self.sharding = NamedSharding(self.mesh, P(AXIS, None, None))
             self.words = jax.device_put(
                 jnp.zeros(
@@ -288,6 +500,18 @@ class ShardedBloomFilter(_FilterBase):
         self.words = jax.device_put(jnp.zeros_like(self.words), self.sharding)
         self.n_inserted = 0
 
+    # delete (counting configs only — configs 4 x 5)
+
+    def delete_batch(self, keys) -> None:
+        if not self.config.counting:
+            raise ValueError("delete requires a counting config")
+        keys_u8, lengths, B = self._pack_padded(keys)
+        self.words = self._delete(self.words, keys_u8, lengths)
+        self.n_inserted = max(0, self.n_inserted - B)
+
+    def delete(self, key) -> None:
+        self.delete_batch([key])
+
     def stats(self) -> dict:
         return {
             "m": self.config.m,
@@ -296,8 +520,14 @@ class ShardedBloomFilter(_FilterBase):
             "devices": int(self.mesh.devices.size),
             "n_inserted": self.n_inserted,
             "n_queried": self.n_queried,
-            "fill_ratio": self.fill_ratio(),
-            "estimated_fpr": self.estimated_fpr(),
+            **(
+                {}
+                if self.config.counting
+                else {
+                    "fill_ratio": self.fill_ratio(),
+                    "estimated_fpr": self.estimated_fpr(),
+                }
+            ),
         }
 
     # Persistence: global layout = shard-major concatenation; bit
@@ -305,10 +535,10 @@ class ShardedBloomFilter(_FilterBase):
     # through the same Redis-bitmap format as the single-device filter.
 
     def to_redis_bitmap(self) -> bytes:
-        if self.config.block_bits:
+        if self.config.block_bits or self.config.counting:
             raise ValueError(
-                "blocked layout is not Redis-bitmap exportable (different "
-                "position spec); use to_bytes"
+                "blocked/counting layouts are not Redis-bitmap exportable "
+                "(different position spec); use to_bytes"
             )
         host = np.asarray(self.words).reshape(-1)
         return words_to_redis_bitmap(host, self.config.m)
@@ -317,8 +547,8 @@ class ShardedBloomFilter(_FilterBase):
     def from_redis_bitmap(
         cls, config: FilterConfig, data: bytes, **kwargs
     ) -> "ShardedBloomFilter":
-        if config.block_bits:
-            raise ValueError("blocked layout restores via from_bytes")
+        if config.block_bits or config.counting:
+            raise ValueError("blocked/counting layouts restore via from_bytes")
         f = cls(config, **kwargs)
         words = redis_bitmap_to_words(data, config.m).reshape(
             config.shards, config.n_words_per_shard

@@ -107,7 +107,7 @@ def blocked_fpr(
     if n == 0:
         return 0.0
     b = block_bits
-    if b % 2 or b < k:
+    if b <= 0 or b & (b - 1) or b < k:
         raise ValueError(f"block_bits must be a power of two >= k, got {b}")
     n_blocks = m // b
     lam = n / n_blocks

@@ -197,6 +197,11 @@ class _ScalableCore:
         ):
             self._push_layer()
             got = self.layers[i].config.to_dict()
+            # normalize the stored dict through from_dict so its legacy
+            # shims apply (headers written before block_hash existed must
+            # compare as the "ap" spec they were built with, exactly as
+            # FilterConfig.from_dict restores them)
+            cfg_dict = FilterConfig.from_dict(dict(cfg_dict)).to_dict()
             if got != cfg_dict:
                 raise ValueError(
                     f"layer {i} config mismatch on restore: policy derives "

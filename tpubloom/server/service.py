@@ -230,17 +230,20 @@ class BloomService:
                     raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
             if restored is not None:
                 filt = restored
+            elif config.shards > 1:
+                # handles flat/blocked x plain/counting layouts (the same
+                # routing order as checkpoint.restore — the two MUST agree
+                # or a restart would reinterpret checkpoint bytes under a
+                # different position spec)
+                from tpubloom.parallel.sharded import ShardedBloomFilter
+
+                filt = ShardedBloomFilter(config)
             elif config.counting and config.block_bits:
                 from tpubloom.filter import BlockedCountingBloomFilter
 
                 filt = BlockedCountingBloomFilter(config)
             elif config.counting:
                 filt = CountingBloomFilter(config)
-            elif config.shards > 1:
-                # handles both flat and blocked layouts
-                from tpubloom.parallel.sharded import ShardedBloomFilter
-
-                filt = ShardedBloomFilter(config)
             elif config.block_bits:
                 from tpubloom.filter import BlockedBloomFilter
 
@@ -354,7 +357,12 @@ class BloomService:
 
     def DeleteBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
-        if not hasattr(mf.filter, "delete_batch"):
+        # attribute presence is not the signal (ShardedBloomFilter carries
+        # delete_batch for all layouts and raises on non-counting): the
+        # config decides, and non-counting filters stay code UNSUPPORTED
+        if not getattr(mf.filter.config, "counting", False) or not hasattr(
+            mf.filter, "delete_batch"
+        ):
             raise protocol.BloomServiceError(
                 "UNSUPPORTED", "delete requires a counting filter"
             )

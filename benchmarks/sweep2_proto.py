@@ -57,7 +57,7 @@ from tpubloom.ops.sweep import (
 LOG2M = 32
 B = 1 << 22
 KEY_LEN = 16
-STEPS = 8
+STEPS = 32
 
 config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
 NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
@@ -68,17 +68,23 @@ def _u32(x):
     return jnp.asarray(x, jnp.uint32)
 
 
-def _delta_merge_free(sub, base, R_SUB, KMAX, W, int8: bool):
+def _delta_merge_free(sub, base, R_SUB, KMAX, W, int8: bool, oh_f32=None,
+                      bits=None):
     """uint32[R_SUB, W] OR-delta of update window ``sub`` ([KMAX, LANES]:
-    col 0 block id, cols 1..W masks) against rows [base, base+R_SUB)."""
-    rl = (sub[:, 0:1] - base).astype(jnp.int32)
-    colsR = lax.broadcasted_iota(jnp.int32, (KMAX, R_SUB), 1)
-    m = sub[:, 1 : W + 1]
-    colC = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
-    rep = jnp.concatenate([m] * 32, axis=1)
-    bits = (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
+    col 0 block id, cols 1..W masks) against rows [base, base+R_SUB).
+    ``oh_f32``/``bits`` let callers share the one-hot row match and the
+    mask bit-plane expansion."""
+    if oh_f32 is None:
+        rl = (sub[:, 0:1] - base).astype(jnp.int32)
+        colsR = lax.broadcasted_iota(jnp.int32, (KMAX, R_SUB), 1)
+        oh_f32 = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+    if bits is None:
+        m = sub[:, 1 : W + 1]
+        colC = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
+        rep = jnp.concatenate([m] * 32, axis=1)
+        bits = (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
     if int8:
-        oh = jnp.where(rl == colsR, 1, 0).astype(jnp.int8)
+        oh = oh_f32.astype(jnp.int8)
         bits8 = bits.astype(jnp.int8)
         cnt = lax.dot_general(
             oh, bits8, (((0,), (0,)), ((), ())),
@@ -88,9 +94,7 @@ def _delta_merge_free(sub, base, R_SUB, KMAX, W, int8: bool):
             jnp.bfloat16
         )
     else:
-        oh = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0)).astype(
-            jnp.bfloat16
-        )
+        oh = oh_f32.astype(jnp.bfloat16)
         bitsf = bits.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
         cnt = lax.dot_general(
             oh, bitsf, (((0,), (0,)), ((), ())),
@@ -140,21 +144,86 @@ def _delta_merge_free(sub, base, R_SUB, KMAX, W, int8: bool):
     )
 
 
+def _presence_of(sub, oh_f32, tile, m, KMAX, W):
+    """f32[KMAX, 1] pre-update membership of each slot: extract the slot's
+    OLD row one 8-bit quarter at a time (bf16-exact) and test
+    (row & mask) == mask across all W words."""
+    oh = oh_f32.astype(jnp.bfloat16)
+    acc_ok = None
+    for q in range(4):
+        tq = (
+            ((tile >> _u32(8 * q)) & _u32(0xFF))
+            .astype(jnp.int32)
+            .astype(jnp.float32)
+            .astype(jnp.bfloat16)
+        )
+        rq = lax.dot_general(
+            oh, tq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        rq_u = rq.astype(jnp.int32).astype(jnp.uint32)
+        mq = (m >> _u32(8 * q)) & _u32(0xFF)
+        ok = jnp.where((mq & rq_u) == mq, jnp.float32(1), jnp.float32(0))
+        acc_ok = ok if acc_ok is None else acc_ok * ok
+    return jnp.min(acc_ok, axis=1, keepdims=True)
+
+
+def _pack_pres(v, KMAX, LANES_OUT=128):
+    """[KMAX, 1] u32 slot values -> [8, LANES_OUT] tile via 4 exact byte
+    matmuls (slot j at (j % 8, j // 8); columns >= KMAX//8 are zero
+    padding so the output block stays 128-lane aligned — a 48-lane
+    output block measurably serializes the out stream). Mosaic has no
+    sublane->lane reshape, hence the matmuls."""
+    jj8 = lax.broadcasted_iota(jnp.int32, (KMAX, 8), 0)
+    aa8 = lax.broadcasted_iota(jnp.int32, (KMAX, 8), 1)
+    oh_a = jnp.where(jj8 % 8 == aa8, jnp.float32(1), jnp.float32(0))
+    jjc = lax.broadcasted_iota(jnp.int32, (KMAX, LANES_OUT), 0)
+    ccc = lax.broadcasted_iota(jnp.int32, (KMAX, LANES_OUT), 1)
+    oh_b = jnp.where(jjc // 8 == ccc, jnp.float32(1), jnp.float32(0)).astype(
+        jnp.bfloat16
+    )
+    pres = jnp.zeros((8, LANES_OUT), jnp.uint32)
+    for q in range(4):
+        vb = ((v >> _u32(8 * q)) & _u32(0xFF)).astype(jnp.int32).astype(
+            jnp.float32
+        )
+        left = (oh_a * vb).astype(jnp.bfloat16)
+        outq = lax.dot_general(
+            left, oh_b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        pres = pres | (outq.astype(jnp.int32).astype(jnp.uint32) << _u32(8 * q))
+    return pres
+
+
+def _expand_bits(m, KMAX, W):
+    """[KMAX, W] packed words -> [KMAX, W*32] 0/1 bit-planes, b-major
+    (column c = b*W + w holds bit b of word w)."""
+    colC = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
+    rep = jnp.concatenate([m] * 32, axis=1)
+    return (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
+
+
 def _kernel2(
     starts_ref,  # SMEM [P_sub + 1] i32
     upd_ref,  # ANY [Btot, LANES]
     blocks_ref,  # VMEM [R_DMA, W]
-    out_ref,  # VMEM [R_DMA, W]
-    sup_ref,  # VMEM [2, KMAX_BIG, LANES]
-    sems,
-    *,
+    *rest,  # out_ref [, pres_ref], sup_ref, sems
     R_SUB: int,
     S: int,
     KMAX_SUB: int,
     KMAX_BIG: int,
     W: int,
     INT8: bool,
+    LEVEL: str = "full",  # "A" stream only | "B" +onehot+bits | "full"
+    PRES: bool = False,
+    PRESV3: bool = False,
 ):
+    if PRES:
+        out_ref, pres_ref, sup_ref, sems = rest
+    else:
+        out_ref, sup_ref, sems = rest
+        pres_ref = None
     p = pl.program_id(0)
     num_p = pl.num_programs(0)
 
@@ -184,22 +253,117 @@ def _kernel2(
         fetch(1 - slot, p + 1)
 
     wait(slot)
+    if LEVEL == "A":
+        row = sup_ref[slot, 0:1, 1 : W + 1]
+        out_ref[:] = blocks_ref[:] | (row * _u32(0))
+        return
     o_big = off_big(p)
+    pres_acc = (
+        jnp.zeros((KMAX_SUB, 128), jnp.uint32) if (PRES and PRESV3) else None
+    )
     for t in range(S):
         q = p * S + t
         rel = (starts_ref[q] // _ALIGN) * _ALIGN - o_big
         sub = sup_ref[slot, pl.ds(rel, KMAX_SUB), :]
         base = (_u32(p) * _u32(S * R_SUB)) + _u32(t * R_SUB)
-        delta = _delta_merge_free(sub, base, R_SUB, KMAX_SUB, W, INT8)
         sl = pl.ds(t * R_SUB, R_SUB)
+        if LEVEL == "B":
+            rl = (sub[:, 0:1] - base).astype(jnp.int32)
+            colsR = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, R_SUB), 1)
+            m = sub[:, 1 : W + 1]
+            colC = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, W * 32), 1)
+            rep = jnp.concatenate([m] * 32, axis=1)
+            bits = (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
+            oh = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+            cheap = jnp.min(oh, axis=1, keepdims=True) + jnp.min(
+                bits.astype(jnp.int32).astype(jnp.float32), axis=1, keepdims=True
+            )
+            out_ref[sl, :] = blocks_ref[sl, :] | (
+                cheap.astype(jnp.int32).astype(jnp.uint32) * _u32(0)
+            )
+            continue
+        rl = (sub[:, 0:1] - base).astype(jnp.int32)
+        colsR = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, R_SUB), 1)
+        oh_f32 = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+        bits0 = _expand_bits(sub[:, 1 : W + 1], KMAX_SUB, W) if (
+            PRES and PRESV3
+        ) else None
+        delta = _delta_merge_free(sub, base, R_SUB, KMAX_SUB, W, INT8,
+                                  oh_f32=oh_f32, bits=bits0)
+        if PRES and PRESV3:
+            # presence without per-slot extraction matmuls: ONE big int8
+            # matmul projects each slot's OLD row bits (oh @ tilebits),
+            # then VPU row-sums decide all-mask-bits-present. The 8
+            # small matmuls of the v1 scheme cost ~50ms/pass in launch
+            # overhead; this is 1 launch + VPU.
+            bits = bits0
+            tilebits = _expand_bits(blocks_ref[sl, :], R_SUB, W)
+            proj = lax.dot_general(
+                oh_f32.astype(jnp.int8), tilebits.astype(jnp.int8),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [KMAX, 512] old-row bits per slot (0/1)
+            bi = bits.astype(jnp.int32)
+            hit = jnp.sum(bi * proj, axis=1, keepdims=True)
+            npos = jnp.sum(bi, axis=1, keepdims=True)
+            idxp1 = sub[:, W + 1 : W + 2]
+            a_q = o_big + rel
+            ipos = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, 1), 0) + a_q
+            real = (
+                (ipos >= starts_ref[q]) & (ipos < starts_ref[q + 1]) & (idxp1 > 0)
+            )
+            hbit = jnp.where(hit == npos, _u32(0x80000000), _u32(0))
+            v = jnp.where(real, idxp1 | hbit, _u32(0))
+            # slot values ride column t of the per-step [KMAX, 128] tile
+            colp = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, 128), 1)
+            pres_acc = pres_acc | jnp.where(colp == t, v, _u32(0))
+        elif PRES:
+            m = sub[:, 1 : W + 1]
+            hit0 = _presence_of(sub, oh_f32, blocks_ref[sl, :], m, KMAX_SUB, W)
+            idxp1 = sub[:, W + 1 : W + 2]
+            a_q = o_big + rel
+            ipos = lax.broadcasted_iota(jnp.int32, (KMAX_SUB, 1), 0) + a_q
+            real = (
+                (ipos >= starts_ref[q]) & (ipos < starts_ref[q + 1]) & (idxp1 > 0)
+            )
+            hbit = jnp.where(hit0 > 0.5, _u32(0x80000000), _u32(0))
+            v = jnp.where(real, idxp1 | hbit, _u32(0))
+            pres_ref[pl.ds(t * 8, 8), :] = _pack_pres(v, KMAX_SUB)
         out_ref[sl, :] = blocks_ref[sl, :] | delta
+    if PRES and PRESV3:
+        pres_ref[:] = pres_acc
 
 
-def sweep2_insert(blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8):
+def sweep2_insert(
+    blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8,
+    LEVEL="full", PRES=False, PRESV3=False,
+):
     NB_, W_ = blocks.shape
     R_DMA = R_SUB * S
     P = NB_ // R_DMA
     LANES = upd.shape[1]
+    out_shape = jax.ShapeDtypeStruct((NB_, W_), jnp.uint32)
+    out_spec = pl.BlockSpec((R_DMA, W_), lambda p, *_: (p, 0))
+    if PRES and PRESV3:
+        # per-step [KMAX_SUB, 128] tile: slot j of sub-tile t at (j, t)
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((P * KMAX_SUB, 128), jnp.uint32),
+        )
+        out_spec = (
+            out_spec,
+            pl.BlockSpec((KMAX_SUB, 128), lambda p, *_: (p, 0)),
+        )
+    elif PRES:
+        # 128-lane-padded presence tiles (slots live in cols < KMAX_SUB//8)
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((P * S * 8, 128), jnp.uint32),
+        )
+        out_spec = (
+            out_spec,
+            pl.BlockSpec((S * 8, 128), lambda p, *_: (p, 0)),
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(P,),
@@ -207,7 +371,7 @@ def sweep2_insert(blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8):
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((R_DMA, W_), lambda p, *_: (p, 0)),
         ],
-        out_specs=pl.BlockSpec((R_DMA, W_), lambda p, *_: (p, 0)),
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((2, KMAX_BIG, LANES), jnp.uint32),
             pltpu.SemaphoreType.DMA((2,)),
@@ -217,9 +381,9 @@ def sweep2_insert(blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8):
         functools.partial(
             _kernel2,
             R_SUB=R_SUB, S=S, KMAX_SUB=KMAX_SUB, KMAX_BIG=KMAX_BIG,
-            W=W_, INT8=INT8,
+            W=W_, INT8=INT8, LEVEL=LEVEL, PRES=PRES, PRESV3=PRESV3,
         ),
-        out_shape=jax.ShapeDtypeStruct((NB_, W_), jnp.uint32),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         input_output_aliases={2: 0},
     )
@@ -227,7 +391,8 @@ def sweep2_insert(blocks, upd, starts, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8):
 
 
 def build_stream(keys, R_sub, KMAX_big, lanes):
-    """Sorted narrow update stream + R_sub-granular partition boundaries."""
+    """Sorted update stream (with idx column) + R_sub-granular partition
+    boundaries."""
     P_sub = NB // R_sub
     blk, bit = blocked.block_positions(
         keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
@@ -235,9 +400,10 @@ def build_stream(keys, R_sub, KMAX_big, lanes):
     )
     blk = blk.astype(jnp.uint32)
     cols, nbits, packed = _pack_positions(bit, BB, K)
-    sorted_cols = lax.sort((blk,) + cols, num_keys=1)
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+    sorted_cols = lax.sort((blk,) + cols + (idx0,), num_keys=1)
     bs = sorted_cols[0].astype(jnp.int32)
-    bit_sorted = _unpack_positions(sorted_cols[1:], BB, K, nbits, packed)
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
     masks = blocked.build_masks(bit_sorted, W)
     starts = jnp.searchsorted(
         bs, (jnp.arange(P_sub + 1, dtype=jnp.int32) * R_sub).astype(jnp.int32)
@@ -250,6 +416,7 @@ def build_stream(keys, R_sub, KMAX_big, lanes):
         )
     )
     upd = upd.at[:B, 1 : W + 1].set(masks)
+    upd = upd.at[:B, W + 1].set(sorted_cols[-1])
     return starts, upd
 
 
@@ -266,19 +433,25 @@ def check_windows(starts, S, KMAX_sub, KMAX_big):
 
 
 def run_variant(name, starts, upd, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8,
-                ref_state=None):
+                ref_state=None, LEVEL="full", PRES=False, PRESV3=False):
     def step(state, upd, starts):
         out = sweep2_insert(
             state, upd, starts,
             R_SUB=R_SUB, S=S, KMAX_SUB=KMAX_SUB, KMAX_BIG=KMAX_BIG, INT8=INT8,
+            LEVEL=LEVEL, PRES=PRES, PRESV3=PRESV3,
         )
+        if PRES:
+            out, presb = out
+            return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32) + jnp.sum(
+                presb[:: max(1, presb.shape[0] // 64)], dtype=jnp.uint32
+            )
         return out, jnp.sum(out[:: NB // 64], dtype=jnp.uint32)
 
     jit = jax.jit(step, donate_argnums=(0,))
     state = jnp.zeros((NB, W), jnp.uint32)
     t0 = time.perf_counter()
     state, carry = jit(state, upd, starts)
-    carry.block_until_ready()
+    _ = int(np.asarray(carry))  # force a host value: bur alone can LIE here
     compile_s = time.perf_counter() - t0
     ok = None
     if ref_state is not None:
@@ -287,20 +460,27 @@ def run_variant(name, starts, upd, *, R_SUB, S, KMAX_SUB, KMAX_BIG, INT8,
         ) and bool(
             jnp.array_equal(state[1 :: NB // 1024], ref_state[1 :: NB // 1024])
         )
+    # TIMING RECIPE (measured 2026-07-30): on this axon stack
+    # block_until_ready can return WITHOUT waiting for plain-XLA work
+    # (a chained 8192^3 matmul "measured" 25,649 TFLOP/s = 130x peak).
+    # Only a long chained loop forced to a HOST VALUE is trustworthy;
+    # the first to-value sync also carries a large one-time cost, so
+    # steps must amortize it.
     t0 = time.perf_counter()
     for _ in range(STEPS):
         state, carry = jit(state, upd, starts)
     carry.block_until_ready()
+    bur_dt = (time.perf_counter() - t0) / STEPS
+    _ = int(np.asarray(carry))
     dt = (time.perf_counter() - t0) / STEPS
     P = NB // (R_SUB * S)
-    # blocks stream alone is 2 * NB * W * 4 bytes; faster than HBM can
-    # move it means the axon timing anomaly hit (see r_sweep_r3 notes)
     implausible = (2 * NB * W * 4 / dt) > 900e9
     print(
         json.dumps(
             {
                 "variant": name,
                 "timing_implausible": implausible,
+                "bur_ms": round(bur_dt * 1e3, 3),
                 "R_sub": R_SUB, "S": S, "KMAX_sub": KMAX_SUB,
                 "KMAX_big": KMAX_BIG, "lanes": int(upd.shape[1]),
                 "int8": INT8, "grid": P,
@@ -341,18 +521,14 @@ def main():
     # stream cannot be window-fetched. The A-floor is per-grid-step
     # overhead, not bytes, so wide rows + big S is the attack.
     variants = [
-        # (name, R_sub, S, lanes, int8)
-        ("wide128 R512 S1 (C repro)", 512, 1, 128, False),
-        ("wide128 R512 S4", 512, 4, 128, False),
-        ("wide128 R512 S8", 512, 8, 128, False),
-        ("wide128 R256 S16", 256, 16, 128, False),
-        ("wide128 R512 S8 int8", 512, 8, 128, True),
-        ("wide128 R256 S16 int8", 256, 16, 128, True),
-        ("wide128 R128 S32 int8", 128, 32, 128, True),
-        ("wide128 R1024 S4", 1024, 4, 128, False),
+        # (name, R_sub, S, lanes, int8, level, pres, presv3)
+        ("S8 int8 presV3", 512, 8, 128, True, "full", True, True),
+        ("S4 int8 presV3", 512, 4, 128, True, "full", True, True),
+        ("S8 R256 int8 presV3", 256, 8, 128, True, "full", True, True),
+        ("S16 int8 presV3", 512, 16, 128, True, "full", True, True),
     ]
     built = {}
-    for name, r_sub, s, lanes, int8 in variants:
+    for name, r_sub, s, lanes, int8, level, pres, presv3 in variants:
         lam_sub = B * r_sub // NB
         KMAX_sub = min(1024, max(16, (lam_sub + max(16, int(8 * lam_sub**0.5)) + 7) // 8 * 8))
         lam_big = lam_sub * s
@@ -378,7 +554,8 @@ def main():
             run_variant(
                 name, starts, upd,
                 R_SUB=r_sub, S=s, KMAX_SUB=KMAX_sub, KMAX_BIG=KMAX_big,
-                INT8=int8, ref_state=ref_state,
+                INT8=int8, ref_state=ref_state if level == "full" else None,
+                LEVEL=level, PRES=pres, PRESV3=presv3,
             )
         except Exception as e:
             print(json.dumps({"variant": name, "error": repr(e)[:400]}),

@@ -1,0 +1,465 @@
+#!/usr/bin/env python
+"""sweep3 prototype — fat-row (128-lane) partition sweep.
+
+hbm_probe.py measured the decisive fact: Pallas DMA of this chip moves
+[*, 16]-lane tiles at ~35 GB/s but [*, 128]-lane tiles at ~150-190 GB/s
+(the (8, 128) DMA tiling wastes 8x on narrow tiles). The block array
+[NB, 16] is the SAME row-major memory as [NB/8, 128], so the sweep can
+run entirely on fat rows:
+
+* keys sort by skey = (blk % 8) * NB8 + (blk >> 3): eight substreams,
+  one per block-column j; substream j's updates land in lanes
+  [16j, 16j+16) of the fat rows, so each substream's delta is produced
+  independently and lane-concatenated — no sublane<->lane moves.
+* placement one-hot is over FAT rows (R8 of them), so the cnt matmul
+  shrinks ~8x per window vs the block-row design at equal coverage.
+* presence (test-and-insert) via G = bits @ tilebits^T (one int8 matmul
+  per window) + tiny VPU rowsums — no per-slot extraction matmuls.
+
+Timing: long chains forced to host values (bur can lie on this stack).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _ALIGN,
+    _pack_positions,
+    _unpack_positions,
+    apply_blocked_updates,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 32
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+NB8 = NB // 8
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _expand_bits(m, KMAX, W):
+    """[KMAX, W] packed words -> [KMAX, W*32] 0/1 planes (b-major)."""
+    colC = lax.broadcasted_iota(jnp.int32, (KMAX, W * 32), 1)
+    rep = jnp.concatenate([m] * 32, axis=1)
+    return (rep >> (colC // W).astype(jnp.uint32)) & _u32(1)
+
+
+def _pack_512_to_16(present_bf16, W):
+    """[R8, 512] 0/1 bf16 bit-planes -> [R8, W] u32 words (exact matmuls)."""
+    ccol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 0)
+    hcol = lax.broadcasted_iota(jnp.int32, (W * 32, 4 * W), 1)
+    b_of_c = ccol // W
+    w_of_c = lax.rem(ccol, W)
+    pack_w = jnp.where(
+        (w_of_c + (b_of_c // 8) * W) == hcol,
+        (1 << lax.rem(b_of_c, 8)).astype(jnp.float32),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    quarters = lax.dot_general(
+        present_bf16, pack_w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.bfloat16)
+    qcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 0)
+    wcol = lax.broadcasted_iota(jnp.int32, (4 * W, W), 1)
+    q_of = qcol // W
+    w_of = lax.rem(qcol, W)
+    comb_lo = jnp.where(
+        (w_of == wcol) & (q_of < 2),
+        jnp.where(q_of == 0, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    comb_hi = jnp.where(
+        (w_of == wcol) & (q_of >= 2),
+        jnp.where(q_of == 2, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    lo = lax.dot_general(
+        quarters, comb_lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    hi = lax.dot_general(
+        quarters, comb_hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << _u32(16)
+    )
+
+
+def _kernel3(
+    starts_ref,  # SMEM [8 * P8 + 1] i32
+    upd_ref,  # ANY [Btot, 128]
+    blocks_ref,  # VMEM [S * R8, 128] (fat rows)
+    *rest,  # out_ref [, pres_ref], sup_ref, sems
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    P8: int,
+    W: int,
+    PRES: bool,
+):
+    if PRES:
+        out_ref, pres_ref, sup_ref, sems = rest
+    else:
+        out_ref, sup_ref, sems = rest
+        pres_ref = None
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+
+    def a_big(j, pp):
+        return (starts_ref[j * P8 + pp * S] // _ALIGN) * _ALIGN
+
+    def fetch(slot, pp):
+        for j in range(8):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(a_big(j, pp), KBJ), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).start()
+
+    def wait(slot):
+        for j in range(8):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(0, KBJ), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, 0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, p + 1)
+
+    wait(slot)
+    pres_acc = jnp.zeros((KJ, 128), jnp.uint32) if PRES else None
+    for t in range(S):
+        sl = pl.ds(t * R8, R8)
+        tile = blocks_ref[sl, :]  # [R8, 128] pre-update fat rows
+        base_rf = (p * S + t) * R8
+        deltas = []
+        for j in range(8):
+            qi = j * P8 + p * S + t
+            rel = (starts_ref[qi] // _ALIGN) * _ALIGN - a_big(j, p)
+            rel = jnp.clip(rel, 0, KBJ - KJ)
+            sub = sup_ref[slot, j, pl.ds(rel, KJ), :]
+            skey0 = j * NB8 + base_rf
+            rl = (sub[:, 0:1] - _u32(skey0)).astype(jnp.int32)
+            colsR = lax.broadcasted_iota(jnp.int32, (KJ, R8), 1)
+            oh_f32 = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+            oh8 = oh_f32.astype(jnp.int8)
+            m = sub[:, 1 : W + 1]
+            bits = _expand_bits(m, KJ, W)
+            bits8 = bits.astype(jnp.int8)
+            cnt = lax.dot_general(
+                oh8, bits8, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [R8, 512]
+            present = jnp.where(cnt > 0, jnp.float32(1), jnp.float32(0)).astype(
+                jnp.bfloat16
+            )
+            deltas.append(_pack_512_to_16(present, W))
+            if PRES:
+                # pre-update membership: G[s, r] = popcount(mask_s AND
+                # oldrow_r) via one int8 matmul; slot s hits iff
+                # G[s, rl(s)] == popcount(mask_s)
+                tj = tile[:, j * W : (j + 1) * W]  # [R8, W]
+                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
+                G = lax.dot_general(
+                    bits8, tilebits, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )  # [KJ, R8]
+                hit = jnp.sum(
+                    G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
+                )
+                npos = jnp.sum(bits.astype(jnp.int32), axis=1, keepdims=True)
+                idxp1 = sub[:, W + 1 : W + 2]
+                aq = a_big(j, p) + rel
+                ipos = lax.broadcasted_iota(jnp.int32, (KJ, 1), 0) + aq
+                real = (
+                    (ipos >= starts_ref[qi])
+                    & (ipos < starts_ref[qi + 1])
+                    & (idxp1 > 0)
+                )
+                hbit = jnp.where(hit == npos, _u32(0x80000000), _u32(0))
+                v = jnp.where(real, idxp1 | hbit, _u32(0))
+                colp = lax.broadcasted_iota(jnp.int32, (KJ, 128), 1)
+                pres_acc = pres_acc | jnp.where(colp == t * 8 + j, v, _u32(0))
+        delta_fat = jnp.concatenate(deltas, axis=1)  # [R8, 128]
+        out_ref[sl, :] = tile | delta_fat
+    if PRES:
+        pres_ref[:] = pres_acc
+
+
+def sweep3_insert(blocks_fat, upd, starts, *, R8, S, KJ, KBJ, PRES=False):
+    NB8_, L = blocks_fat.shape
+    assert L == 128
+    P8 = NB8_ // R8
+    P = P8 // S
+    out_shape = jax.ShapeDtypeStruct((NB8_, 128), jnp.uint32)
+    out_spec = pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0))
+    if PRES:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((P * KJ, 128), jnp.uint32),
+        )
+        out_spec = (out_spec, pl.BlockSpec((KJ, 128), lambda p, *_: (p, 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0)),
+        ],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, KBJ, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, 8)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _kernel3, R8=R8, S=S, KJ=KJ, KBJ=KBJ, P8=P8, W=W, PRES=PRES
+        ),
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+    )
+    return fn(starts, upd, blocks_fat)
+
+
+def build_stream3(keys, R8, KBJ):
+    """Sorted substream update stream: skey = (blk%8)*NB8 + blk>>3."""
+    P8 = NB8 // R8
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    blk = blk.astype(jnp.uint32)
+    skey = (blk & _u32(7)) * _u32(NB8) + (blk >> _u32(3))
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+    sorted_cols = lax.sort((skey,) + cols + (idx0,), num_keys=1)
+    ss = sorted_cols[0].astype(jnp.int32)
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    bounds = (
+        jnp.arange(8 * P8 + 1, dtype=jnp.int32)
+        .reshape(-1)
+    )
+    # boundary q of substream j at skey j*NB8 + q*R8; flatten j-major
+    jj = bounds // P8
+    qq = bounds % P8
+    tgt = jnp.where(bounds == 8 * P8, 8 * NB8, jj * NB8 + qq * R8)
+    starts = jnp.searchsorted(ss, tgt.astype(jnp.int32)).astype(jnp.int32)
+    pad = KBJ + _ALIGN
+    upd = jnp.zeros((B + pad, 128), jnp.uint32)
+    upd = upd.at[:, 0].set(
+        jnp.concatenate(
+            [ss.astype(jnp.uint32), jnp.full((pad,), 8 * NB8, jnp.uint32)]
+        )
+    )
+    upd = upd.at[:B, 1 : W + 1].set(masks)
+    upd = upd.at[:B, W + 1].set(sorted_cols[-1])
+    return starts, upd
+
+
+def check_windows3(starts, S, KJ, KBJ, P8):
+    s = np.asarray(starts).astype(np.int64)
+    a_big = np.empty(8 * P8, np.int64)
+    for j in range(8):
+        seg = (s[j * P8 : (j + 1) * P8 : S] // _ALIGN) * _ALIGN
+        a_big[j * P8 : (j + 1) * P8] = np.repeat(seg, S)
+    a = (s[:-1] // _ALIGN) * _ALIGN
+    rel = np.clip(a - a_big, 0, KBJ - KJ)
+    aa = a_big + rel
+    span = s[1:] - aa  # rows window [aa, aa+KJ) must cover
+    return int(span.max())
+
+
+def unsort_presence(presb, starts, R8, S, KJ, KBJ, P8):
+    """Device-side: pres tiles -> bool[B] in original key order."""
+    s = starts.astype(jnp.int32)
+    P = P8 // S
+    # stream position of slot (global q, i): a(q) + i
+    jq = jnp.arange(8 * P8, dtype=jnp.int32)
+    j = jq // P8
+    q = jq % P8
+    p0 = q // S
+    t = q % S
+    big_idx = j * P8 + p0 * S
+    a_big = (s[big_idx] // _ALIGN) * _ALIGN
+    a = a_big + jnp.clip((s[jq] // _ALIGN) * _ALIGN - a_big, 0, KBJ - KJ)
+    # v values: presb[p0*KJ + i, t*8 + j] -> row-gather from a
+    # [P*128, KJ] transpose so each (j, q) window is one row
+    presT = presb.reshape(P, KJ, 128).transpose(0, 2, 1).reshape(P * 128, KJ)
+    v = presT[p0 * 128 + t * 8 + j]  # [8*P8, KJ]
+    vkey = jnp.where(
+        v == 0,
+        _u32(0xFFFFFFFE),
+        ((v & _u32(0x7FFFFFFF)) << _u32(1)) | (v >> _u32(31)),
+    ).reshape(-1)
+    (skey,) = lax.sort((vkey,), num_keys=1)
+    return (skey[:B] & _u32(1)) == 1
+
+
+def run_variant(name, starts, upd, *, R8, S, KJ, KBJ, PRES, ref_state=None):
+    def step(state, upd, starts):
+        out = sweep3_insert(
+            state, upd, starts, R8=R8, S=S, KJ=KJ, KBJ=KBJ, PRES=PRES
+        )
+        if PRES:
+            out, presb = out
+            return out, jnp.sum(out[:: NB8 // 64], dtype=jnp.uint32) + jnp.sum(
+                presb[:: max(1, presb.shape[0] // 64)], dtype=jnp.uint32
+            )
+        return out, jnp.sum(out[:: NB8 // 64], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((NB8, 128), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, upd, starts)
+    _ = int(np.asarray(carry))
+    compile_s = time.perf_counter() - t0
+    ok = None
+    if ref_state is not None:
+        ok = bool(
+            jnp.array_equal(state[:: NB8 // 4096], ref_state[:: NB8 // 4096])
+        ) and bool(
+            jnp.array_equal(state[1 :: NB8 // 1024], ref_state[1 :: NB8 // 1024])
+        )
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    _ = int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    P = NB8 // (R8 * S)
+    print(
+        json.dumps(
+            {
+                "variant": name, "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ,
+                "grid": P, "ms": round(dt * 1e3, 3),
+                "keys_per_sec": round(B / dt),
+                "compile_s": round(compile_s, 1),
+                "matches_shipping": ok,
+            }
+        ),
+        flush=True,
+    )
+    del state
+    return None
+
+
+def main():
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(rng.integers(0, 256, (B, KEY_LEN), np.uint8))
+
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    ref_state = jax.jit(
+        lambda b, bl, bi: apply_blocked_updates(
+            b, bl, bi, jnp.ones((B,), bool), block_bits=BB, interpret=False
+        )
+    )(jnp.zeros((NB, W), jnp.uint32), blk, bit)
+    ref_fat = ref_state.reshape(NB8, 128)
+    ref_fat.block_until_ready()
+
+    variants = [
+        # (name, R8, S, pres)
+        ("fat R8=256 S4 +pres", 256, 4, True),
+        ("fat R8=128 S8 +pres", 128, 8, True),
+        ("fat R8=512 S2 +pres", 512, 2, True),
+        ("fat R8=256 S4", 256, 4, False),
+        ("fat R8=256 S8 +pres", 256, 8, True),
+    ]
+    built = {}
+    for name, r8, s, pres in variants:
+        lam = B * r8 // NB  # per (j, q) window
+        KJ = max(16, (lam + max(16, int(8 * lam**0.5)) + 7) // 8 * 8)
+        lam_big = lam * s
+        KBJ = ((lam_big + KJ + 64 + 7) // 8) * 8
+        P8 = NB8 // r8
+        key_ = (r8, KBJ)
+        if key_ not in built:
+            starts, upd = jax.jit(lambda kk: build_stream3(kk, r8, KBJ))(keys)
+            starts.block_until_ready()
+            built[key_] = (starts, upd)
+        starts, upd = built[key_]
+        span = check_windows3(starts, s, KJ, KBJ, P8)
+        if span > KJ:
+            print(json.dumps({"variant": name, "skip": "window overflow",
+                              "span": span, "KJ": KJ}), flush=True)
+            continue
+        try:
+            run_variant(
+                name, starts, upd, R8=r8, S=s, KJ=KJ, KBJ=KBJ, PRES=pres,
+                ref_state=ref_fat,
+            )
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": repr(e)[:300]}),
+                  flush=True)
+
+    # presence correctness: insert the same stream into the JUST-updated
+    # state — every valid key must report present
+    name, r8, s = "presence replay check", 64, 8
+    lam = B * r8 // NB
+    KJ = max(16, (lam + max(16, int(8 * lam**0.5)) + 7) // 8 * 8)
+    KBJ = ((lam * s + KJ + 64 + 7) // 8) * 8
+    P8 = NB8 // r8
+    starts, upd = built[(r8, KBJ)]
+    state = jnp.zeros((NB8, 128), jnp.uint32)
+    state, presb = jax.jit(
+        lambda st, u, ss: sweep3_insert(
+            st, u, ss, R8=r8, S=s, KJ=KJ, KBJ=KBJ, PRES=True
+        )
+    )(state, upd, starts)
+    pres1 = jax.jit(
+        lambda pb, ss: unsort_presence(pb, ss, r8, s, KJ, KBJ, P8)
+    )(presb, starts)
+    state2, presb2 = jax.jit(
+        lambda st, u, ss: sweep3_insert(
+            st, u, ss, R8=r8, S=s, KJ=KJ, KBJ=KBJ, PRES=True
+        )
+    )(state, upd, starts)
+    pres2 = jax.jit(
+        lambda pb, ss: unsort_presence(pb, ss, r8, s, KJ, KBJ, P8)
+    )(presb2, starts)
+    n1 = int(jnp.sum(pres1))
+    n2 = int(jnp.sum(pres2))
+    print(json.dumps({
+        "check": "presence replay",
+        "first_pass_present": n1,
+        "second_pass_present": n2,
+        "expect_second": B,
+        "ok": n2 == B,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Property tests for the fat-sweep parameter chooser (VERDICT r2 #9-10:
+auto must never pick an unsupported shape; R selection is no longer
+restricted to {512, 1024})."""
+
+from hypothesis import given, settings, strategies as st
+
+from tpubloom.ops.sweep import choose_fat_params, sweep_applicable
+
+
+@settings(max_examples=300, deadline=None)
+@given(
+    log2_nb=st.integers(min_value=3, max_value=26),
+    log2_b=st.integers(min_value=4, max_value=25),
+    w=st.sampled_from([4, 8, 16, 32, 64]),
+    presence=st.booleans(),
+)
+def test_choose_fat_params_always_valid(log2_nb, log2_b, w, presence):
+    nb, batch = 1 << log2_nb, 1 << log2_b
+    out = choose_fat_params(nb, batch, w, presence=presence)
+    if out is None:
+        return
+    J, R8, S, KJ, KBJ = out
+    assert J == 128 // w and nb % J == 0
+    NBJ = nb // J
+    assert NBJ % R8 == 0, "sub-tiles must tile the fat rows exactly"
+    P8 = NBJ // R8
+    assert P8 % S == 0 and P8 // S >= 2, "grid must have >= 2 steps"
+    assert KJ % 8 == 0 and 16 <= KJ <= 1024
+    assert KBJ % 8 == 0 and KBJ >= KJ
+    lam = batch * R8 // nb
+    assert KJ >= min(1024, lam), "window must cover expected occupancy"
+    if presence:
+        assert S * R8 <= 512, "presence kernels cap the tile at 512 fat rows"
+        assert S * J <= 128, "presence slot columns must fit 128 lanes"
+    # VMEM budget: windows + in/out/pres tiles with headroom
+    assert 2 * J * KBJ * 128 * 4 + 4 * (S * R8 * 128 * 4) <= 12 * 1024 * 1024
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    log2_nb=st.integers(min_value=3, max_value=26),
+    log2_b=st.integers(min_value=4, max_value=25),
+    w=st.sampled_from([4, 8, 16, 32, 64, 128]),
+)
+def test_sweep_applicable_never_lies(log2_nb, log2_b, w):
+    """If auto says "sweep", one of the two kernels must actually accept
+    the shape (fat qualifies, or the legacy guards pass)."""
+    from tpubloom.ops.sweep import choose_params
+
+    nb, batch = 1 << log2_nb, 1 << log2_b
+    if not sweep_applicable(nb, batch, w):
+        return
+    if choose_fat_params(nb, batch, w) is not None:
+        return
+    R, kmax = choose_params(nb, batch)
+    assert nb % R == 0 and w + 2 <= 128 and R % 32 == 0

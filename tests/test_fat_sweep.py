@@ -1,0 +1,123 @@
+"""Fat-row sweep correctness (interpret mode on CPU) — the shipping TPU
+hot loop's bit-exactness contract, exercised at shapes where
+choose_fat_params actually selects it (the legacy test_sweep.py shapes
+fall back to the old kernel).
+
+Real-Mosaic validation of the same contracts runs on hardware via
+benchmarks/adversarial.py (interpret mode alone is weak evidence for
+this kernel family — Mosaic has miscompiled lane patterns silently)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked, sweep
+
+NB, BB, K, B = 8192, 512, 7, 8192
+CFG = FilterConfig(m=NB * BB, k=K, key_len=16, block_bits=BB)
+W = CFG.words_per_block
+
+
+def _positions(keys_u8, lengths):
+    return blocked.block_positions(
+        keys_u8, jnp.maximum(lengths, 0),
+        n_blocks=NB, block_bits=BB, k=K, seed=CFG.seed,
+        block_hash=CFG.block_hash,
+    )
+
+
+def _scatter_ref(blk, bit, valid):
+    masks = blocked.build_masks(bit, W)
+    return blocked.blocked_insert(
+        jnp.zeros((NB, W), jnp.uint32), blk, masks, valid
+    )
+
+
+@pytest.fixture(scope="module")
+def uniform_batch():
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 256, (B, 16), np.uint8))
+    lengths = jnp.full((B,), 16, jnp.int32)
+    return keys, lengths
+
+
+def test_fat_params_selected_here():
+    assert sweep.choose_fat_params(NB, B, W) is not None
+    assert sweep.choose_fat_params(NB, B, W, presence=True) is not None
+
+
+def test_fat_insert_matches_scatter(uniform_batch):
+    keys, lengths = uniform_batch
+    blk, bit = _positions(keys, lengths)
+    valid = jnp.ones((B,), bool)
+    ref = _scatter_ref(blk, bit, valid)
+    params = sweep.choose_fat_params(NB, B, W)
+    out = sweep.apply_fat_updates(
+        jnp.zeros((NB, W), jnp.uint32), blk, bit, valid,
+        block_bits=BB, params=params, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_fat_presence_replay_and_state(uniform_batch):
+    keys, lengths = uniform_batch
+    ins = sweep.make_sweep_insert_fn(CFG, interpret=True, with_presence=True)
+    st, p1 = ins(jnp.zeros((NB, W), jnp.uint32), keys, lengths)
+    assert int(p1.sum()) == 0, "fresh keys must not be present"
+    st2, p2 = ins(st, keys, lengths)
+    assert int(p2.sum()) == B, "replayed keys must all be present"
+    blk, bit = _positions(keys, lengths)
+    ref = _scatter_ref(blk, bit, jnp.ones((B,), bool))
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(ref))
+
+
+def test_fat_presence_tail_padding(uniform_batch):
+    """The documented contract: padding is a TAIL suffix; padded entries
+    report False and valid entries keep correct, unshifted verdicts."""
+    keys, lengths = uniform_batch
+    lp = lengths.at[B - 100 :].set(-1)
+    ins = sweep.make_sweep_insert_fn(CFG, interpret=True, with_presence=True)
+    st, p1 = ins(jnp.zeros((NB, W), jnp.uint32), keys, lp)
+    assert int(p1.sum()) == 0
+    st2, p2 = ins(st, keys, lp)
+    assert bool(np.asarray(p2)[: B - 100].all()), "valid keys shifted/lost"
+    assert not np.asarray(p2)[B - 100 :].any(), "padded entries must be False"
+    blk, bit = _positions(keys, lp)
+    ref = _scatter_ref(blk, bit, lp >= 0)
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(ref))
+
+
+def test_fat_duplicate_skew_falls_back_bit_exact():
+    """Window overflow (duplicate skew) must route the whole batch to the
+    scatter branch and stay bit-exact, presence included."""
+    rng = np.random.default_rng(1)
+    dup = jnp.asarray(
+        np.tile(rng.integers(0, 256, (16, 16), np.uint8), (B // 16, 1))
+    )
+    lengths = jnp.full((B,), 16, jnp.int32)
+    blk, bit = _positions(dup, lengths)
+    valid = jnp.ones((B,), bool)
+    ref = _scatter_ref(blk, bit, valid)
+    params = sweep.choose_fat_params(NB, B, W)
+    out = sweep.apply_fat_updates(
+        jnp.zeros((NB, W), jnp.uint32), blk, bit, valid,
+        block_bits=BB, params=params, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    ins = sweep.make_sweep_insert_fn(CFG, interpret=True, with_presence=True)
+    st, p1 = ins(jnp.zeros((NB, W), jnp.uint32), dup, lengths)
+    assert int(p1.sum()) == 0
+    st2, p2 = ins(st, dup, lengths)
+    assert int(p2.sum()) == B
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(ref))
+
+
+def test_fat_small_filter_feasibility_fallback():
+    """choose_fat_params must try smaller R8 when the score-best one has
+    no feasible grid (review finding: nb=512, batch=256 previously
+    returned None although R8=32 qualifies)."""
+    out = sweep.choose_fat_params(512, 256, 16)
+    assert out is not None
+    J, R8, S, KJ, KBJ = out
+    assert (512 // J) % R8 == 0 and ((512 // J) // R8) // S >= 2

@@ -1,0 +1,147 @@
+"""Pin params.blocked_fpr against measured FPR (VERDICT r2 #5).
+
+The analytic model (Poisson mixture over per-block loads + Stirling
+distinct-position distribution + AP family floor) silently mis-advises
+every capacity decision if wrong, so every cell of the
+fill x block_bits x block_hash matrix is measured: insert n keys chosen
+for a target fill, probe absent keys, and require the observed count to
+sit inside a Poisson-wide band around model * probes.
+
+ops/blocked.py cites this file as the model's measurement anchor.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from tpubloom import FilterConfig
+from tpubloom.filter import BlockedBloomFilter
+from tpubloom.params import blocked_fpr, theoretical_fpr
+
+M = 1 << 20
+K = 4
+PROBES = 1 << 19  # 512k, in 2 batches
+CHUNK = 1 << 18
+
+
+def _n_for_fill(fill: float) -> int:
+    """n with expected overall fill (1 - e^{-k n / m}) == fill."""
+    return int(-M * math.log(1.0 - fill) / K)
+
+
+def _measure_fpr(config: FilterConfig, n: int, seed: int) -> float:
+    rng = np.random.default_rng(seed)
+    f = BlockedBloomFilter(config)
+    lengths = np.full(n, 16, np.int32)
+    f.insert_arrays(rng.integers(0, 256, (n, 16), np.uint8), lengths)
+    hits = 0
+    for i in range(PROBES // CHUNK):
+        probe = rng.integers(0, 256, (CHUNK, 16), np.uint8)
+        pl = np.full(CHUNK, 16, np.int32)
+        hits += int(np.asarray(f.include_arrays(probe, pl)).sum())
+    return hits / PROBES, hits
+
+
+@pytest.mark.parametrize("block_hash", ["chunk", "ap"])
+@pytest.mark.parametrize("block_bits", [256, 512, 1024])
+@pytest.mark.parametrize("fill", [0.05, 0.15, 0.30])
+def test_blocked_fpr_model_matches_measurement(fill, block_bits, block_hash):
+    n = _n_for_fill(fill)
+    config = FilterConfig(
+        m=M, k=K, key_len=16, block_bits=block_bits, block_hash=block_hash
+    )
+    model = blocked_fpr(
+        n, m=M, k=K, block_bits=block_bits, block_hash=block_hash
+    )
+    observed, hits = _measure_fpr(config, n, seed=hash((fill, block_bits, block_hash)) & 0xFFFF)
+    expect = model * PROBES
+    # Poisson-wide acceptance: 6 sigma + 35% model tolerance + a floor of
+    # 8 counts for the near-zero cells
+    tol = max(6.0 * math.sqrt(max(expect, 1.0)), 0.35 * expect, 8.0)
+    assert abs(hits - expect) <= tol, (
+        f"fill={fill} b={block_bits} hash={block_hash}: measured {hits} "
+        f"hits vs model {expect:.1f} (±{tol:.1f}) over {PROBES} probes "
+        f"(observed FPR {observed:.2e}, model {model:.2e})"
+    )
+
+
+def test_model_orderings():
+    """Structural facts the model must reproduce: blocked >= flat at equal
+    fill (Jensen), ap >= chunk (family floor), floor linear in load."""
+    n = _n_for_fill(0.15)
+    for b in (256, 512, 1024):
+        chunk = blocked_fpr(n, m=M, k=K, block_bits=b, block_hash="chunk")
+        ap = blocked_fpr(n, m=M, k=K, block_bits=b, block_hash="ap")
+        flat = theoretical_fpr(M, K, n)
+        assert chunk >= flat * 0.98, (b, chunk, flat)
+        assert ap > chunk, (b, ap, chunk)
+        # the AP floor term alone: lam * 4 / b^2
+        lam = n / (M // b)
+        assert ap - chunk >= 0.5 * lam * 4.0 / (b * b)
+
+
+def test_model_validates_inputs():
+    with pytest.raises(ValueError, match="power of two"):
+        blocked_fpr(10, m=M, k=K, block_bits=12)
+    with pytest.raises(ValueError, match="power of two"):
+        blocked_fpr(10, m=M, k=K, block_bits=0)
+    assert blocked_fpr(0, m=M, k=K, block_bits=512) == 0.0
+
+
+def test_ap_device_vs_oracle_parity():
+    """Explicit block_hash='ap' device path == pure-NumPy oracle bit for
+    bit (the legacy spec that keeps old checkpoints readable — VERDICT r2
+    weak #4: it was only ever exercised via the default)."""
+    from tpubloom.cpu_ref import CPUBlockedBloomFilter
+
+    config = FilterConfig(
+        m=1 << 16, k=5, key_len=16, block_bits=512, block_hash="ap"
+    )
+    rng = np.random.default_rng(3)
+    keys = [rng.bytes(16) for _ in range(2000)] + [b"", b"a", "unicode-✓"]
+    f = BlockedBloomFilter(config)
+    o = CPUBlockedBloomFilter(config, use_native=False)
+    f.insert_batch(keys)
+    o.insert_batch(keys)
+    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    probe = keys + [rng.bytes(16) for _ in range(2000)]
+    np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
+
+
+def test_plain_blocked_pre_block_hash_checkpoint_restores_as_ap(tmp_path):
+    """A blocked checkpoint whose header predates the block_hash field
+    must restore as the AP spec (config.from_dict mapping) — and refuse a
+    chunk-config restore with a clear identity error."""
+    import json
+
+    from tpubloom import checkpoint as ckpt
+
+    ap_cfg = FilterConfig(
+        m=1 << 16, k=5, key_len=16, block_bits=512, block_hash="ap",
+        key_name="legacy-blk",
+    )
+    rng = np.random.default_rng(4)
+    keys = [rng.bytes(16) for _ in range(1500)]
+    f = BlockedBloomFilter(ap_cfg)
+    f.insert_batch(keys)
+    sink = ckpt.FileSink(str(tmp_path))
+    ckpt.save(f, sink)
+    # strip the field as a pre-block_hash writer would have
+    import pathlib
+
+    path = max(pathlib.Path(tmp_path).glob("legacy-blk.*.ckpt"))
+    blob = path.read_bytes()
+    header, payload = ckpt._deserialize(blob)
+    header["config"].pop("block_hash")
+    hdr = json.dumps(header).encode()
+    path.write_bytes(ckpt.MAGIC + len(hdr).to_bytes(8, "little") + hdr + payload)
+
+    g = ckpt.restore(ap_cfg, sink)
+    assert isinstance(g, BlockedBloomFilter)
+    assert g.config.block_hash == "ap"
+    assert g.include_batch(keys).all()
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+    with pytest.raises(ValueError, match="mismatch on block_hash"):
+        ckpt.restore(ap_cfg.replace(block_hash="chunk"), sink)

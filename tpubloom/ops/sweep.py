@@ -139,6 +139,8 @@ def sweep_applicable(
         # the update-stream row holds block id + W mask words + key idx
         # in 128 lanes; block_bits=4096 (W=128) does not fit
         return False
+    if choose_fat_params(n_blocks, batch, words_per_block) is not None:
+        return True
     R, kmax = choose_params(n_blocks, batch)
     P = max(1, n_blocks // R)
     if n_blocks % R != 0 or R % 32 != 0:
@@ -805,6 +807,12 @@ def apply_blocked_updates(
     nb, w = blocks.shape
     B = blk.shape[0]
     k = bit.shape[-1]
+    fat = choose_fat_params(nb, B, w)
+    if fat is not None:
+        return apply_fat_updates(
+            blocks, blk, bit, valid,
+            block_bits=block_bits, params=fat, interpret=interpret,
+        )
     R, KMAX = choose_params(nb, B)
     if nb % R != 0 or w + 2 > 128 or R % 32 != 0:
         # R must be a multiple of 32 for the Kronecker one-hot split
@@ -824,6 +832,487 @@ def apply_blocked_updates(
     starts, upd = _stream_scaffold(bs, nb, P, R, KMAX)
     upd = upd.at[:B, 1 : w + 1].set(masks)
     return sweep_insert(blocks, upd, starts, R=R, KMAX=KMAX, interpret=interp)
+
+
+# =========================================================================
+# Fat-row (128-lane) partition sweep — "sweep3", the shipping TPU hot loop
+# =========================================================================
+#
+# Why a second kernel generation: benchmarks/hbm_probe.py measured that
+# this chip's Pallas DMA moves [*, W=16]-lane tiles at ~35 GB/s but
+# [*, 128]-lane tiles at ~150-190 GB/s (the (8, 128) DMA tiling wastes
+# 8x on narrow tiles), so the original per-block-row pipeline above was
+# bandwidth-crippled by its own layout. A [NB, W] u32 block array is the
+# SAME row-major memory as [NB/J, 128] with J = 128/W blocks per fat
+# row, so the fat sweep:
+#
+# * sorts keys by skey = (blk mod J) * NBJ + (blk div J): J substreams,
+#   one per block-column j; substream j's updates touch only lanes
+#   [j*W, (j+1)*W) of the fat rows, so each substream's delta is
+#   produced independently and lane-concatenated — no sublane<->lane
+#   moves anywhere;
+# * runs the placement one-hot over FAT rows (R8 per sub-tile), so the
+#   cnt matmul is J-times narrower per window at equal coverage — the
+#   int8 MXU does NB*bb*KJ MACs/pass with KJ ~ lambda+8sigma per
+#   (j, window);
+# * computes fused test-and-insert presence with ONE extra int8 matmul
+#   per window (G = mask_bits @ oldrow_bits^T; slot hits iff
+#   G[s, row(s)] == popcount(mask_s)) instead of per-slot extraction.
+#
+# Measured on the same chip / same stream (B=4M, m=2^32, k=7, bb=512,
+# to-value timing): insert-only 31-34 ms (124-135M keys/s) vs 77 ms for
+# the legacy kernel; fused test-and-insert 70 ms (60M keys/s) vs 115 ms.
+# Results are bit-identical to the legacy kernel and the XLA scatter
+# path (same blocked position spec).
+
+
+def choose_fat_params(
+    nb: int, batch: int, words_per_block: int = 16, *, presence: bool = False
+):
+    """(J, R8, S, KJ, KBJ) for the fat sweep, or None if the shape does
+    not qualify (callers fall back to the legacy kernel / scatter).
+
+    J = blocks per 128-lane fat row; R8 = fat rows per placement
+    sub-tile; S = sub-tiles per grid step (DMA granularity); KJ = update
+    slots per (substream, sub-tile) window (lambda + 8 sigma, multiple
+    of 8); KBJ = rows per substream big-window fetch. Presence kernels
+    cap S*R8 at 512 fat rows — larger tiles blow the 16 MiB VMEM scoped
+    limit (measured: 24.5M requested at S*R8=1024)."""
+    import math
+
+    w = words_per_block
+    J = 128 // w
+    if J < 1 or w * J != 128 or nb % J:
+        return None
+    NBJ = nb // J
+    cap = 512 if presence else 1024
+    candidates = []
+    for r8 in (32, 64, 128, 256, 512, 1024):
+        if r8 > NBJ or NBJ % r8:
+            continue
+        lam = batch * r8 // nb
+        if lam < 8:
+            continue
+        score = abs(math.log2(max(lam, 1)) - 7)  # prefer lambda ~ 128
+        candidates.append((score, r8, lam))
+    # feasibility (grid depth, lane columns, VMEM) is checked per
+    # candidate, best score first — a smaller R8 may qualify where the
+    # score-best one cannot (e.g. tiny filters where P8 // S < 2)
+    for _, R8, lam in sorted(candidates):
+        KJ = min(
+            1024,
+            max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8),
+        )
+        P8 = NBJ // R8
+        for s in (8, 4, 2, 1):
+            if P8 % s or s * R8 > cap or P8 // s < 2:
+                continue
+            if presence and s * J > 128:
+                # presence slot values ride column t*J + j of a
+                # 128-lane tile
+                continue
+            kbj = ((lam * s + KJ + 64 + 7) // 8) * 8
+            # scoped-VMEM estimate: double-buffered windows + block tiles
+            if 2 * J * kbj * 128 * 4 + 4 * (s * R8 * 128 * 4) <= 9 * 1024 * 1024:
+                return J, R8, s, KJ, kbj
+    return None
+
+
+def _expand_bits(m: jnp.ndarray, rows: int, w: int) -> jnp.ndarray:
+    """[rows, w] packed u32 words -> [rows, w*32] 0/1 planes, b-major
+    (column c = b*w + word holds bit b of that word)."""
+    colC = lax.broadcasted_iota(jnp.int32, (rows, w * 32), 1)
+    rep = jnp.concatenate([m] * 32, axis=1)
+    return (rep >> (colC // w).astype(jnp.uint32)) & _u32(1)
+
+
+def _pack_planes(present_bf16: jnp.ndarray, w: int) -> jnp.ndarray:
+    """[rows, w*32] 0/1 bf16 planes -> [rows, w] u32 words via exact
+    matmuls (8-bit quarters then 16-bit halves; every operand/result is
+    integer-exact in the matmul dtype)."""
+    ccol = lax.broadcasted_iota(jnp.int32, (w * 32, 4 * w), 0)
+    hcol = lax.broadcasted_iota(jnp.int32, (w * 32, 4 * w), 1)
+    b_of_c = ccol // w
+    w_of_c = lax.rem(ccol, w)
+    pack_w = jnp.where(
+        (w_of_c + (b_of_c // 8) * w) == hcol,
+        (1 << lax.rem(b_of_c, 8)).astype(jnp.float32),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    quarters = lax.dot_general(
+        present_bf16, pack_w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.bfloat16)
+    qcol = lax.broadcasted_iota(jnp.int32, (4 * w, w), 0)
+    wcol = lax.broadcasted_iota(jnp.int32, (4 * w, w), 1)
+    q_of = qcol // w
+    w_of = lax.rem(qcol, w)
+    comb_lo = jnp.where(
+        (w_of == wcol) & (q_of < 2),
+        jnp.where(q_of == 0, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    comb_hi = jnp.where(
+        (w_of == wcol) & (q_of >= 2),
+        jnp.where(q_of == 2, jnp.float32(1), jnp.float32(256)),
+        jnp.float32(0),
+    ).astype(jnp.bfloat16)
+    lo = lax.dot_general(
+        quarters, comb_lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    hi = lax.dot_general(
+        quarters, comb_hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << _u32(16)
+    )
+
+
+def _fat_kernel(
+    starts_ref,  # SMEM [J * P8 + 1] i32 (scalar prefetch)
+    upd_ref,  # ANY [Btot, 128]: col 0 skey, 1..W masks, W+1 idx+1
+    blocks_ref,  # VMEM [S * R8, 128] fat rows (auto-streamed)
+    *rest,  # out_ref [, pres_ref], sup_ref, sems
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    P8: int,
+    W: int,
+    J: int,
+    NBJ: int,
+    PRES: bool,
+):
+    if PRES:
+        out_ref, pres_ref, sup_ref, sems = rest
+    else:
+        out_ref, sup_ref, sems = rest
+        pres_ref = None
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+
+    def a_big(j, pp):
+        return (starts_ref[j * P8 + pp * S] // _ALIGN) * _ALIGN
+
+    def fetch(slot, pp):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(a_big(j, pp), KBJ), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).start()
+
+    def wait(slot):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(0, KBJ), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, 0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, p + 1)
+
+    wait(slot)
+    pres_acc = jnp.zeros((KJ, 128), jnp.uint32) if PRES else None
+    for t in range(S):
+        sl = pl.ds(t * R8, R8)
+        tile = blocks_ref[sl, :]  # [R8, 128] pre-update fat rows
+        base_rf = (p * S + t) * R8
+        deltas = []
+        for j in range(J):
+            qi = j * P8 + p * S + t
+            skey0 = _u32(j * NBJ) + _u32(base_rf)
+            colsR = lax.broadcasted_iota(jnp.int32, (KJ, R8), 1)
+
+            def win_parts(sub):
+                """(delta_words, oh_f32, bits, npos-free parts) of one
+                KJ-row update window against this sub-tile."""
+                rl = (sub[:, 0:1] - skey0).astype(jnp.int32)
+                oh_f32 = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+                bits = _expand_bits(sub[:, 1 : W + 1], KJ, W)
+                cnt = lax.dot_general(
+                    oh_f32.astype(jnp.int8), bits.astype(jnp.int8),
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )  # [R8, W*32]
+                present = jnp.where(
+                    cnt > 0, jnp.float32(1), jnp.float32(0)
+                ).astype(jnp.bfloat16)
+                return _pack_planes(present, W), oh_f32, bits
+
+            rel = (starts_ref[qi] // _ALIGN) * _ALIGN - a_big(j, p)
+            rel = jnp.clip(rel, 0, KBJ - KJ)
+            sub0 = sup_ref[slot, j, pl.ds(rel, KJ), :]
+            delta_j, oh_f32, bits = win_parts(sub0)
+            # NO in-kernel overflow chunks: a dynamic DMA loop in the body
+            # defeats Mosaic's pipelining (measured +86% kernel time even
+            # with zero iterations). Windows that overflow KJ (adversarial
+            # duplicate skew only) are detected host-side from `starts`
+            # and the WHOLE batch falls back to the sorted-scatter path
+            # under lax.cond — see apply_fat_updates.
+            a0 = a_big(j, p) + rel
+            end = starts_ref[qi + 1]
+            deltas.append(delta_j)
+
+            if PRES:
+                # G[s, r] = popcount(mask_s AND oldrow_r): one int8
+                # matmul; slot s was present iff its own row's count
+                # equals popcount(mask_s)
+                tj = tile[:, j * W : (j + 1) * W]
+                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
+                G = lax.dot_general(
+                    bits.astype(jnp.int8), tilebits,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )  # [KJ, R8]
+                hit = jnp.sum(
+                    G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
+                )
+                npos = jnp.sum(bits.astype(jnp.int32), axis=1, keepdims=True)
+                idxp1 = sub0[:, W + 1 : W + 2]
+                ipos = lax.broadcasted_iota(jnp.int32, (KJ, 1), 0) + a0
+                real = (
+                    (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
+                )
+                hbit = jnp.where(hit == npos, _u32(0x80000000), _u32(0))
+                v = jnp.where(real, idxp1 | hbit, _u32(0))
+                colp = lax.broadcasted_iota(jnp.int32, (KJ, 128), 1)
+                pres_acc = pres_acc | jnp.where(colp == t * J + j, v, _u32(0))
+        delta_fat = jnp.concatenate(deltas, axis=1)  # [R8, J*W = 128]
+        out_ref[sl, :] = tile | delta_fat
+    if PRES:
+        pres_ref[:] = pres_acc
+
+
+def fat_sweep_insert(
+    blocks_fat: jnp.ndarray,
+    upd: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    J: int,
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    W: int,
+    interpret: bool = False,
+    with_presence: bool = False,
+):
+    """Apply a substream-sorted update stream to the fat-row block view.
+
+    ``blocks_fat``: ``uint32[NB/J, 128]`` (reshape of the [NB, W] array);
+    ``upd``: ``uint32[Btot, 128]`` sorted by skey (col 0), masks in cols
+    1..W, original index + 1 in col W+1 (presence), ``>= KBJ + 8`` rows
+    of sentinel tail padding; ``starts``: ``int32[J*P8 + 1]`` window
+    boundaries, j-major. Returns the updated fat view, plus — with
+    presence — ``uint32[P*KJ, 128]`` slot-value tiles (slot i of window
+    (j, q) at row ``(q // S)*KJ + i``, column ``(q % S)*J + j``, value
+    ``idx+1 | was_present << 31``; 0 = empty slot)."""
+    NB8, L = blocks_fat.shape
+    assert L == 128
+    P8 = NB8 // R8
+    P = P8 // S
+    out_shape = jax.ShapeDtypeStruct((NB8, 128), jnp.uint32)
+    out_spec = pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0))
+    if with_presence:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((P * KJ, 128), jnp.uint32),
+        )
+        out_spec = (out_spec, pl.BlockSpec((KJ, 128), lambda p, *_: (p, 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0)),
+        ],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((2, J, KBJ, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, J)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _fat_kernel,
+            R8=R8, S=S, KJ=KJ, KBJ=KBJ, P8=P8, W=W, J=J, NBJ=NB8,
+            PRES=with_presence,
+        ),
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )
+    return fn(starts, upd, blocks_fat)
+
+
+def _fat_stream(skey_sorted, masks, idx_sorted, *, J, NBJ, P8, R8, KBJ, W):
+    """Single-pass update-stream assembly for the fat sweep: one
+    concatenate builds the [Btot, 128] buffer (multiple .at[].set()
+    passes measurably cost ~2 GB of extra HBM writes each at B=4M)."""
+    B = masks.shape[0]
+    pad = KBJ + _ALIGN
+    cols = [skey_sorted.astype(jnp.uint32)[:, None], masks]
+    ncols = 1 + W
+    if idx_sorted is not None:
+        cols.append(idx_sorted.astype(jnp.uint32)[:, None])
+        ncols += 1
+    core = jnp.concatenate(cols, axis=1)
+    # jnp.pad lowers to one fused write here; concatenating explicit
+    # zero blocks measurably costs ~2x (2 GB array at B=4M)
+    upd = jnp.pad(core, ((0, pad), (0, 128 - ncols)))
+    upd = upd.at[B:, 0].set(jnp.uint32(J * NBJ))
+    jq = jnp.arange(J * P8 + 1, dtype=jnp.int32)
+    tgt = jnp.where(
+        jq == J * P8, J * NBJ, (jq // P8) * NBJ + (jq % P8) * R8
+    ).astype(jnp.int32)
+    starts = jnp.searchsorted(skey_sorted.astype(jnp.int32), tgt).astype(
+        jnp.int32
+    )
+    return upd, starts
+
+
+def _fat_window_overflow(starts, *, J, P8, S, KJ, KBJ):
+    """True if any (j, q) window cannot cover its slice from the clamped
+    KJ-row fetch. The fat kernel has NO chunk loop (rows beyond the KJ
+    window are silently never applied), so on overflow apply_fat_updates
+    routes the WHOLE batch — insert AND presence — to the sorted-scatter
+    branch under lax.cond; that branch is the only thing keeping
+    overflowing batches correct."""
+    s = starts
+    jq = jnp.arange(J * P8, dtype=jnp.int32)
+    big_idx = (jq // P8) * P8 + ((jq % P8) // S) * S
+    a_big = (s[big_idx] // _ALIGN) * _ALIGN
+    a = a_big + jnp.clip((s[jq] // _ALIGN) * _ALIGN - a_big, 0, KBJ - KJ)
+    return jnp.max(s[jq + 1] - a) > KJ
+
+
+def _fat_unsort_presence(presb, starts, B, *, J, NBJ, P8, R8, S, KJ, KBJ):
+    """Presence tiles -> bool[B] in original key order via the vkey
+    single-column unsort (idx+1 rides bits 1.., verdict the LSB; empty
+    slots sink to the tail)."""
+    P = P8 // S
+    jq = jnp.arange(J * P8, dtype=jnp.int32)
+    j = jq // P8
+    q = jq % P8
+    p0 = q // S
+    t = q % S
+    presT = presb.reshape(P, KJ, 128).transpose(0, 2, 1).reshape(P * 128, KJ)
+    v = presT[p0 * 128 + t * J + j]  # [J*P8, KJ]
+    vkey = jnp.where(
+        v == 0,
+        _u32(0xFFFFFFFE),  # even: empty slots must read as hit=0
+        ((v & _u32(0x7FFFFFFF)) << _u32(1)) | (v >> _u32(31)),
+    ).reshape(-1)
+    (skey,) = lax.sort((vkey,), num_keys=1)
+    return (skey[:B] & _u32(1)) == 1
+
+
+def apply_fat_updates(
+    blocks: jnp.ndarray,
+    blk: jnp.ndarray,
+    bit: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    block_bits: int,
+    params,
+    interpret: bool | None = None,
+    idx: jnp.ndarray | None = None,
+):
+    """Fat-sweep counterpart of :func:`apply_blocked_updates`; ``params``
+    from :func:`choose_fat_params`.
+
+    Windows that overflow their KJ fetch (adversarial duplicate skew —
+    uniform keys sit 8 sigma below) route the WHOLE batch to the
+    sorted-scatter path under ``lax.cond``: the kernel itself carries no
+    chunk loop (a dynamic DMA loop in the body measurably defeats
+    Mosaic's pipelining even at zero iterations).
+
+    Returns the new blocks ([NB, W]); with ``idx`` (original key
+    indices, 1-based — presence mode) returns ``(new_blocks,
+    present[B])`` where ``present`` is each key's PRE-batch membership.
+
+    Presence CONTRACT (same as the legacy kernel): invalid entries
+    (``valid`` False) must form a TAIL SUFFIX of the batch
+    (tpubloom.filter._pack_padded guarantees this). Invalid keys emit no
+    presence slot, so a mid-batch invalid entry would shift every later
+    key's verdict by one in the index-sorted unsort; tail padding keeps
+    valid indices contiguous (1..V) and padded entries correctly read
+    False from the empty-slot fillers.
+    """
+    nb, w = blocks.shape
+    B = blk.shape[0]
+    J, R8, S, KJ, KBJ = params
+    NBJ = nb // J
+    P8 = NBJ // R8
+    interp = jax.default_backend() == "cpu" if interpret is None else interpret
+    blkv = jnp.where(valid, blk, nb)
+    j_of = (blkv % J).astype(jnp.uint32)
+    rf_of = (blkv // J).astype(jnp.uint32)
+    skey = jnp.where(valid, j_of * NBJ + rf_of, _u32(J * NBJ))
+    cols, nbits, packed = _pack_positions(bit, block_bits, bit.shape[-1])
+    extra = (idx,) if idx is not None else ()
+    sorted_cols = lax.sort((skey,) + cols + extra, num_keys=1)
+    ss = sorted_cols[0]
+    pcols = sorted_cols[1:-1] if idx is not None else sorted_cols[1:]
+    bit_sorted = _unpack_positions(
+        pcols, block_bits, bit.shape[-1], nbits, packed
+    )
+    masks = blocked.build_masks(bit_sorted, w)
+    idx_sorted = sorted_cols[-1] if idx is not None else None
+    upd, starts = _fat_stream(
+        ss, masks, idx_sorted, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=w
+    )
+    overflow = _fat_window_overflow(starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ)
+
+    if idx is None:
+
+        def fat_branch(ops):
+            bl, u, st = ops
+            return fat_sweep_insert(
+                bl.reshape(NBJ, 128), u, st,
+                J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, interpret=interp,
+            ).reshape(nb, w)
+
+        def scatter_branch(ops):
+            bl, u, st = ops
+            masks_orig = blocked.build_masks(bit, w)
+            return blocked.blocked_insert(bl, blk, masks_orig, valid)
+
+        return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
+
+    def fat_branch(ops):
+        bl, u, st = ops
+        new_fat, presb = fat_sweep_insert(
+            bl.reshape(NBJ, 128), u, st,
+            J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
+            interpret=interp, with_presence=True,
+        )
+        present = _fat_unsort_presence(
+            presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S, KJ=KJ, KBJ=KBJ
+        )
+        return new_fat.reshape(nb, w), present
+
+    def scatter_branch(ops):
+        bl, u, st = ops
+        masks_orig = blocked.build_masks(bit, w)
+        rows = bl[jnp.minimum(blkv, nb - 1)]
+        hit = jnp.all((rows & masks_orig) == masks_orig, axis=-1)
+        present = hit & valid
+        return blocked.blocked_insert(bl, blk, masks_orig, valid), present
+
+    return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
 
 
 def make_sweep_insert_fn(
@@ -846,8 +1335,12 @@ def make_sweep_insert_fn(
 
     def insert(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
+        # legacy-kernel shape guards apply only when the fat sweep does
+        # not take the batch (apply_blocked_updates / the presence branch
+        # below prefer it)
+        has_fat = choose_fat_params(nb, B, w, presence=with_presence) is not None
         R, KMAX = choose_params(nb, B)
-        if nb % R != 0 or w + 2 > 128 or R % 32 != 0:
+        if not has_fat and (nb % R != 0 or w + 2 > 128 or R % 32 != 0):
             # partitions must tile the array exactly (or trailing blocks
             # would silently never receive updates), the 128-lane update
             # row must fit block id + W mask words + key idx, and R must
@@ -856,7 +1349,7 @@ def make_sweep_insert_fn(
                 f"sweep insert does not support this shape (n_blocks={nb}, "
                 f"R={R}, words_per_block={w}) — use insert_path='scatter'"
             )
-        if with_presence and (nb // R) * KMAX < B:
+        if with_presence and not has_fat and (nb // R) * KMAX < B:
             # the presence output has one slot per chunk-0 window entry;
             # batches larger than P*KMAX cannot all be answered (auto
             # never picks such shapes — only a forced 'sweep' gets here)
@@ -876,6 +1369,13 @@ def make_sweep_insert_fn(
         if not with_presence:
             return apply_blocked_updates(
                 blocks, blk, bit, valid, block_bits=bb, interpret=interpret
+            )
+        fat = choose_fat_params(nb, B, w, presence=True)
+        if fat is not None:
+            idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = empty slot
+            return apply_fat_updates(
+                blocks, blk, bit, valid,
+                block_bits=bb, params=fat, interpret=interpret, idx=idx0,
             )
         blk = jnp.where(valid, blk, nb)
         cols, nbits, packed = _pack_positions(bit, bb, k)

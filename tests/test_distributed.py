@@ -1,0 +1,111 @@
+"""DCN-path test: a real 2-process CPU cluster through
+initialize_multihost (VERDICT r2 #8 — parallel/distributed.py was
+exercised by zero tests).
+
+Two subprocesses each fake 4 CPU devices, join via a localhost
+coordinator, build one 8-shard mesh spanning both processes, and run a
+sharded insert + psum-OR query whose collectives cross the process
+boundary (the DCN tier in miniature)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+
+from tpubloom.parallel.distributed import initialize_multihost
+
+topo = initialize_multihost(coord, 2, pid)
+assert topo["process_count"] == 2, topo
+assert topo["global_device_count"] == 8, topo
+assert topo["local_device_count"] == 4, topo
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpubloom.config import FilterConfig
+from tpubloom.parallel import sharded as sh
+from tpubloom.utils.packing import pack_keys
+
+config = FilterConfig(m=1 << 16, k=5, key_len=16, shards=8)
+mesh = sh.make_mesh(8)
+assert mesh.devices.size == 8
+
+insert = jax.jit(sh.make_sharded_insert_fn(config, mesh), donate_argnums=0)
+query = jax.jit(sh.make_sharded_query_fn(config, mesh))
+
+words = jax.make_array_from_callback(
+    (config.shards, config.n_words_per_shard),
+    NamedSharding(mesh, P(sh.AXIS, None)),
+    lambda idx: np.zeros(
+        (len(range(*idx[0].indices(config.shards))), config.n_words_per_shard),
+        np.uint32,
+    ),
+)
+rng = np.random.default_rng(0)  # same seed on both hosts: identical batch
+present = [rng.bytes(16) for _ in range(128)]
+absent = [rng.bytes(16) for _ in range(128)]
+repl = NamedSharding(mesh, P())
+
+def put(a):
+    a = np.asarray(a)
+    return jax.make_array_from_callback(a.shape, repl, lambda idx: a[idx])
+
+ku, kl = pack_keys(present, config.key_len)
+words = insert(words, put(ku), put(kl))
+pu, plen = pack_keys(present + absent, config.key_len)
+hits = query(words, put(pu), put(plen))
+hits_np = np.asarray(hits)  # fully replicated -> addressable everywhere
+assert hits_np[:128].all(), "cross-process sharded filter lost keys"
+assert hits_np[128:].mean() < 0.05, "implausible FPR"
+print(f"CHILD{pid} OK", flush=True)
+jax.distributed.shutdown()
+"""
+
+
+def test_two_process_cpu_cluster(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # keep the axon site dir on the path (its sitecustomize registers
+        # the plugin jax insists on knowing about) AND the repo root
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("2-process cluster hung: " + " | ".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+        assert f"CHILD{pid} OK" in out

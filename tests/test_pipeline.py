@@ -94,3 +94,34 @@ def test_stream_into_sharded(cfg):
     ins = StreamInserter(f, batch_size=512)
     ins.run(_key_stream(0, 4000))
     assert f.include_batch(list(_key_stream(0, 4000))).all()
+
+
+def test_prefetch_overlap_identical_state(tmp_path):
+    """prefetch (background pack + early H2D) must not change results:
+    same stream -> bit-identical filter vs the synchronous path."""
+    import numpy as np
+
+    from tpubloom import BloomFilter, FilterConfig
+    from tpubloom.parallel.pipeline import StreamInserter
+
+    cfg = FilterConfig(m=1 << 18, k=5, key_len=16)
+    rng = np.random.default_rng(42)
+    keys = [rng.bytes(16) for _ in range(20_000)]
+    a, b = BloomFilter(cfg), BloomFilter(cfg)
+    sa = StreamInserter(a, batch_size=1 << 12).run(iter(keys))
+    sb = StreamInserter(b, batch_size=1 << 12, prefetch=3).run(iter(keys))
+    assert sa["inserted"] == sb["inserted"] == len(keys)
+    np.testing.assert_array_equal(np.asarray(a.words), np.asarray(b.words))
+
+
+def test_prefetch_propagates_pack_errors():
+    import pytest as _pytest
+
+    from tpubloom import BloomFilter, FilterConfig
+    from tpubloom.parallel.pipeline import StreamInserter
+
+    cfg = FilterConfig(m=1 << 16, k=4, key_len=16)  # key_policy=error
+    f = BloomFilter(cfg)
+    bad = [b"x" * 64]  # longer than key_len -> pack_keys raises
+    with _pytest.raises(ValueError):
+        StreamInserter(f, batch_size=8, prefetch=2).run(iter(bad))

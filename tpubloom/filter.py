@@ -218,7 +218,10 @@ def make_blocked_test_insert_fn(config: FilterConfig):
     def test_insert(blocks, keys_u8, lengths):
         from tpubloom.ops import sweep
 
-        if sweep.resolve_insert_path(config, keys_u8.shape[0]) == "sweep":
+        if (
+            sweep.resolve_insert_path(config, keys_u8.shape[0], presence=True)
+            == "sweep"
+        ):
             return sweep.make_sweep_insert_fn(config, with_presence=True)(
                 blocks, keys_u8, lengths
             )

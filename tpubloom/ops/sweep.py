@@ -105,18 +105,30 @@ def choose_params(
 
 
 def auto_insert_path(
-    backend: str, n_blocks: int, batch: int, words_per_block: int = 16
+    backend: str,
+    n_blocks: int,
+    batch: int,
+    words_per_block: int = 16,
+    *,
+    presence: bool = False,
 ) -> str:
     """The implementation ``insert_path="auto"`` resolves to — the single
     source of truth shared by :func:`tpubloom.filter.make_blocked_insert_fn`
     and the benchmark's metadata. The Mosaic kernel only lowers on TPU;
-    every other backend (cpu, gpu, ...) takes the XLA scatter path."""
-    if backend == "tpu" and sweep_applicable(n_blocks, batch, words_per_block):
+    every other backend (cpu, gpu, ...) takes the XLA scatter path.
+    ``presence`` must match the caller's fused-test-and-insert intent:
+    the presence kernel has tighter caps, so the applicability decision
+    and the kernel actually run must use the same predicate."""
+    if backend == "tpu" and sweep_applicable(
+        n_blocks, batch, words_per_block, presence=presence
+    ):
         return "sweep"
     return "scatter"
 
 
-def resolve_insert_path(config, batch: int, backend: str | None = None) -> str:
+def resolve_insert_path(
+    config, batch: int, backend: str | None = None, *, presence: bool = False
+) -> str:
     """Resolve ``config.insert_path`` ("auto"/"sweep"/"scatter") for a
     batch size on the current (or given) backend."""
     if config.insert_path != "auto":
@@ -124,12 +136,14 @@ def resolve_insert_path(config, batch: int, backend: str | None = None) -> str:
     if backend is None:
         backend = jax.default_backend()
     return auto_insert_path(
-        backend, config.n_blocks, batch, config.words_per_block
+        backend, config.n_blocks, batch, config.words_per_block,
+        presence=presence,
     )
 
 
 def sweep_applicable(
-    n_blocks: int, batch: int, words_per_block: int = 16
+    n_blocks: int, batch: int, words_per_block: int = 16, *,
+    presence: bool = False,
 ) -> bool:
     """The sweep wins when the array is large enough that partitions
     outnumber DMA latency and per-partition occupancy fits the fetch
@@ -139,7 +153,7 @@ def sweep_applicable(
         # the update-stream row holds block id + W mask words + key idx
         # in 128 lanes; block_bits=4096 (W=128) does not fit
         return False
-    if choose_fat_params(n_blocks, batch, words_per_block) is not None:
+    if choose_fat_params(n_blocks, batch, words_per_block, presence=presence):
         return True
     R, kmax = choose_params(n_blocks, batch)
     P = max(1, n_blocks // R)
@@ -899,10 +913,13 @@ def choose_fat_params(
     # candidate, best score first — a smaller R8 may qualify where the
     # score-best one cannot (e.g. tiny filters where P8 // S < 2)
     for _, R8, lam in sorted(candidates):
-        KJ = min(
-            1024,
-            max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8),
-        )
+        kj_raw = max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8)
+        if kj_raw > 1024:
+            # a KJ cap at/below mean occupancy would overflow every
+            # window and pay the whole sort+stream build only to fall
+            # back to scatter — mirror the legacy batch//P < kmax guard
+            continue
+        KJ = kj_raw
         P8 = NBJ // R8
         for s in (8, 4, 2, 1):
             if P8 % s or s * R8 > cap or P8 // s < 2:

@@ -24,6 +24,8 @@ Mechanics:
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Iterable, Iterator, Optional
 
 import numpy as np
@@ -33,7 +35,13 @@ from tpubloom.utils.packing import pack_keys
 
 
 class StreamInserter:
-    """Feed an unbounded key stream into a filter at full device rate."""
+    """Feed an unbounded key stream into a filter at full device rate.
+
+    ``prefetch > 0`` overlaps host packing + H2D staging with device
+    compute: a background thread packs the NEXT ``prefetch`` batches and
+    starts their transfers while the device crunches the current one
+    (the 1-core host's pack loop and the tunnel's H2D latency otherwise
+    serialize with every insert dispatch)."""
 
     def __init__(
         self,
@@ -44,10 +52,12 @@ class StreamInserter:
         checkpoint_every: int = 0,
         max_in_flight: int = 8,
         start_offset: int = 0,
+        prefetch: int = 0,
     ):
         self.filter = filter_obj
         self.batch_size = batch_size
         self.max_in_flight = max_in_flight
+        self.prefetch = prefetch
         self.consumed = start_offset  # keys consumed from the stream origin
         self._dispatched_since_sync = 0
         self.checkpointer: Optional[AsyncCheckpointer] = None
@@ -72,25 +82,22 @@ class StreamInserter:
         """
         return self.consumed
 
-    def run(self, keys: Iterable[bytes], *, limit: Optional[int] = None) -> dict:
-        """Consume the stream (optionally at most ``limit`` keys). Returns
-        run stats. Reentrant: call again to continue the same stream."""
-        it: Iterator[bytes] = iter(keys)
-        batch: list = []
-        inserted = 0
+    def _packed_batches(self, it: Iterator[bytes], limit: Optional[int]):
+        """Yield ``(keys_u8, lengths, n_valid)`` fixed-shape batches."""
+        produced = 0
         while True:
-            batch.clear()
             budget = self.batch_size
             if limit is not None:
-                budget = min(budget, limit - inserted)
+                budget = min(budget, limit - produced)
                 if budget <= 0:
-                    break
+                    return
+            batch = []
             for key in it:
                 batch.append(key)
                 if len(batch) >= budget:
                     break
             if not batch:
-                break
+                return
             keys_u8, lengths = pack_keys(
                 batch, self.filter.config.key_len,
                 key_policy=self.filter.config.key_policy,
@@ -99,16 +106,80 @@ class StreamInserter:
                 pad = self.batch_size - len(batch)
                 keys_u8 = np.pad(keys_u8, ((0, pad), (0, 0)))
                 lengths = np.pad(lengths, (0, pad), constant_values=-1)
-            self.filter.insert_arrays(keys_u8, lengths, n_valid=len(batch))
-            inserted += len(batch)
-            self.consumed += len(batch)
+            produced += len(batch)
+            yield keys_u8, lengths, len(batch)
+
+    def _prefetched(self, batches):
+        """Run the packer on a background thread; stage each batch onto
+        the device (jax.device_put starts the H2D without blocking) so
+        transfers overlap device compute. Exceptions re-raise in the
+        consumer."""
+        import jax
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        cancel = threading.Event()
+        _END, _ERR = object(), object()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer is gone — a
+            # plain q.put could block forever on early consumer exit,
+            # stalling the unwind and leaking the thread + its buffers
+            while not cancel.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for keys_u8, lengths, n in batches:
+                    if not put((jax.device_put(keys_u8), jax.device_put(lengths), n)):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                put((_ERR, e, 0))
+                return
+            put((_END, None, 0))
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item[0] is _END:
+                    return
+                if item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            cancel.set()
+            while not q.empty():  # unblock a put-in-progress
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=30)
+
+    def run(self, keys: Iterable[bytes], *, limit: Optional[int] = None) -> dict:
+        """Consume the stream (optionally at most ``limit`` keys). Returns
+        run stats. Reentrant: call again to continue the same stream."""
+        it: Iterator[bytes] = iter(keys)
+        inserted = 0
+        batches = self._packed_batches(it, limit)
+        if self.prefetch:
+            batches = self._prefetched(batches)
+        for keys_u8, lengths, n_valid in batches:
+            self.filter.insert_arrays(keys_u8, lengths, n_valid=n_valid)
+            inserted += n_valid
+            self.consumed += n_valid
             self._dispatched_since_sync += 1
             if self._dispatched_since_sync >= self.max_in_flight:
                 # backpressure: bound the async dispatch queue
                 self.filter.block_until_ready()
                 self._dispatched_since_sync = 0
             if self.checkpointer:
-                self.checkpointer.notify_inserts(len(batch))
+                self.checkpointer.notify_inserts(n_valid)
         self.filter.block_until_ready()
         return {
             "inserted": inserted,

@@ -392,9 +392,7 @@ def restore(
         import jax.numpy as jnp
 
         f = BlockedBloomFilter(config)
-        f.words = jnp.asarray(
-            words.reshape(config.n_blocks, config.words_per_block)
-        )
+        f.words = jnp.asarray(words.reshape(f.words.shape))
     else:
         from tpubloom.filter import BloomFilter
         import jax.numpy as jnp

@@ -110,15 +110,28 @@ def make_counting_query_fn(config: FilterConfig):
     return query
 
 
-def make_blocked_insert_fn(config: FilterConfig):
+def blocked_storage_fat(config: FilterConfig) -> bool:
+    """Whether the persistent blocked storage uses the fat [NB/J, 128]
+    view (the SAME row-major bytes as [NB, W]): XLA's tiled HBM layouts
+    make narrow-lane arrays both slow to DMA and expensive to reshape,
+    so every filter that can holds its device array fat."""
+    w = config.words_per_block
+    return (
+        not config.counting
+        and 128 % w == 0
+        and config.n_blocks % (128 // w) == 0
+    )
+
+
+def make_blocked_insert_fn(config: FilterConfig, *, storage_fat: bool = False):
     """Pure ``(blocks[NB,W], keys_u8[B,L], lengths[B]) -> blocks`` insert for
     the blocked layout (ops.blocked spec).
 
     ``config.insert_path`` selects the implementation: the Pallas
-    partition-sweep kernel (``tpubloom.ops.sweep`` — the TPU fast path,
-    ~3x the sorted-scatter rate at north-star scale) or the pure-XLA
-    sorted scatter. Both produce bit-identical arrays; "auto" decides
-    per (backend, batch shape) at trace time.
+    partition-sweep kernel (``tpubloom.ops.sweep`` — the TPU fast path)
+    or the pure-XLA sorted scatter. Both produce bit-identical arrays;
+    "auto" decides per (backend, batch shape) at trace time.
+    ``storage_fat``: blocks are the fat [NB/J, 128] view in and out.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
@@ -127,14 +140,19 @@ def make_blocked_insert_fn(config: FilterConfig):
         from tpubloom.ops import sweep
 
         if sweep.resolve_insert_path(config, keys_u8.shape[0]) == "sweep":
-            return sweep.make_sweep_insert_fn(config)(blocks, keys_u8, lengths)
+            return sweep.make_sweep_insert_fn(config, storage_fat=storage_fat)(
+                blocks, keys_u8, lengths
+            )
         valid = lengths >= 0
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
-        return blocked.blocked_insert(blocks, blk, masks, valid)
+        out = blocked.blocked_insert(
+            blocks.reshape(nb, w) if storage_fat else blocks, blk, masks, valid
+        )
+        return out.reshape(blocks.shape) if storage_fat else out
 
     return insert
 
@@ -200,7 +218,7 @@ def make_blocked_counting_query_fn(config: FilterConfig):
     return query
 
 
-def make_blocked_test_insert_fn(config: FilterConfig):
+def make_blocked_test_insert_fn(config: FilterConfig, *, storage_fat: bool = False):
     """Pure ``(blocks, keys_u8, lengths) -> (blocks, present[B])``
     test-and-insert for the blocked layout: ``present[i]`` is key i's
     membership BEFORE this batch (within-batch duplicates all report the
@@ -222,9 +240,9 @@ def make_blocked_test_insert_fn(config: FilterConfig):
             sweep.resolve_insert_path(config, keys_u8.shape[0], presence=True)
             == "sweep"
         ):
-            return sweep.make_sweep_insert_fn(config, with_presence=True)(
-                blocks, keys_u8, lengths
-            )
+            return sweep.make_sweep_insert_fn(
+                config, with_presence=True, storage_fat=storage_fat
+            )(blocks, keys_u8, lengths)
         # scatter path: hash once, reuse positions for both the
         # membership test and the insert
         valid = lengths >= 0
@@ -233,16 +251,22 @@ def make_blocked_test_insert_fn(config: FilterConfig):
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
-        present = blocked.blocked_query(blocks, blk, masks) & valid
-        return blocked.blocked_insert(blocks, blk, masks, valid), present
+        bl = blocks.reshape(nb, w) if storage_fat else blocks
+        present = blocked.blocked_query(bl, blk, masks) & valid
+        out = blocked.blocked_insert(bl, blk, masks, valid)
+        return (out.reshape(blocks.shape) if storage_fat else out), present
 
     return test_insert
 
 
-def make_blocked_query_fn(config: FilterConfig):
-    """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked membership."""
+def make_blocked_query_fn(config: FilterConfig, *, storage_fat: bool = False):
+    """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked membership.
+
+    With ``storage_fat`` the gather reads fat [NB/J, 128] rows directly
+    (row = blk // J, lane group blk % J) — no reshape of the array."""
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
+    J = 128 // w if w and 128 % w == 0 else 1
 
     def query(blocks, keys_u8, lengths):
         blk, bit = blocked.block_positions(
@@ -250,7 +274,13 @@ def make_blocked_query_fn(config: FilterConfig):
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
-        return blocked.blocked_query(blocks, blk, masks)
+        if not storage_fat:
+            return blocked.blocked_query(blocks, blk, masks)
+        rows128 = blocks[(blk // J).astype(jnp.int32)]  # [B, 128]
+        lane0 = ((blk % J) * w).astype(jnp.int32)[:, None]
+        cols = lane0 + jnp.arange(w, dtype=jnp.int32)[None, :]
+        rows = jnp.take_along_axis(rows128, cols, axis=1)  # [B, W]
+        return jnp.all((rows & masks) == masks, axis=-1)
 
     return query
 
@@ -399,11 +429,25 @@ class BlockedBloomFilter(_FilterBase):
         if not config.block_bits:
             config = config.replace(block_bits=512)
         super().__init__(config, 0)  # placeholder; storage is 2-D
-        self.words = jnp.zeros(
-            (config.n_blocks, config.words_per_block), jnp.uint32
+        # fat [NB/J, 128] storage where possible: the SAME row-major
+        # bytes as [NB, W], but XLA's tiled HBM layouts DMA narrow-lane
+        # arrays at ~1/5 speed and make the reshape a real copy
+        # (benchmarks/RESULTS_r3.md) — so the persistent array stays fat
+        # and every kernel/gather reads it natively
+        self._fat = blocked_storage_fat(config)
+        shape = (
+            (config.n_blocks * config.words_per_block // 128, 128)
+            if self._fat
+            else (config.n_blocks, config.words_per_block)
         )
-        self._insert = jax.jit(make_blocked_insert_fn(config), donate_argnums=0)
-        self._query = jax.jit(make_blocked_query_fn(config))
+        self.words = jnp.zeros(shape, jnp.uint32)
+        self._insert = jax.jit(
+            make_blocked_insert_fn(config, storage_fat=self._fat),
+            donate_argnums=0,
+        )
+        self._query = jax.jit(
+            make_blocked_query_fn(config, storage_fat=self._fat)
+        )
         self._test_insert = None  # jitted lazily on first return_presence use
 
     def insert_batch(
@@ -418,7 +462,10 @@ class BlockedBloomFilter(_FilterBase):
             return super().insert_batch(keys)
         if self._test_insert is None:
             self._test_insert = jax.jit(
-                make_blocked_test_insert_fn(self.config), donate_argnums=0
+                make_blocked_test_insert_fn(
+                    self.config, storage_fat=self._fat
+                ),
+                donate_argnums=0,
             )
         keys_u8, lengths, B = self._pack_padded(keys)
         self.words, present = self._test_insert(self.words, keys_u8, lengths)
@@ -446,9 +493,7 @@ class BlockedBloomFilter(_FilterBase):
     def from_bytes(cls, config: FilterConfig, data: bytes) -> "BlockedBloomFilter":
         f = cls(config)
         arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
-        f.words = jnp.asarray(
-            arr.reshape(f.config.n_blocks, f.config.words_per_block)
-        )
+        f.words = jnp.asarray(arr.reshape(f.words.shape))
         return f
 
 

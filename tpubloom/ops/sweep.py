@@ -1246,6 +1246,7 @@ def apply_fat_updates(
     params,
     interpret: bool | None = None,
     idx: jnp.ndarray | None = None,
+    storage_fat: bool = False,
 ):
     """Fat-sweep counterpart of :func:`apply_blocked_updates`; ``params``
     from :func:`choose_fat_params`.
@@ -1267,10 +1268,17 @@ def apply_fat_updates(
     key's verdict by one in the index-sorted unsort; tail padding keeps
     valid indices contiguous (1..V) and padded entries correctly read
     False from the empty-slot fillers.
+
+    ``storage_fat``: ``blocks`` is already the fat [NB/J, 128] view and
+    the fat view is returned — no reshape at the kernel boundary (XLA's
+    tiled HBM layouts make [NB, W] <-> fat reshapes REAL copies, ~26 ms
+    per pass at m=2^32; persistent filters keep their storage fat).
     """
-    nb, w = blocks.shape
+    w = block_bits // 32
+    J0, R8, S, KJ, KBJ = params
+    nb = blocks.size // w
     B = blk.shape[0]
-    J, R8, S, KJ, KBJ = params
+    J = J0
     NBJ = nb // J
     P8 = NBJ // R8
     interp = jax.default_backend() == "cpu" if interpret is None else interpret
@@ -1293,47 +1301,62 @@ def apply_fat_updates(
     )
     overflow = _fat_window_overflow(starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ)
 
+    def to_fat(bl):
+        return bl if storage_fat else bl.reshape(NBJ, 128)
+
+    def from_fat(bl_fat):
+        return bl_fat if storage_fat else bl_fat.reshape(nb, w)
+
+    def to_logical(bl):
+        return bl.reshape(nb, w) if storage_fat else bl
+
     if idx is None:
 
         def fat_branch(ops):
             bl, u, st = ops
-            return fat_sweep_insert(
-                bl.reshape(NBJ, 128), u, st,
-                J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, interpret=interp,
-            ).reshape(nb, w)
+            return from_fat(
+                fat_sweep_insert(
+                    to_fat(bl), u, st,
+                    J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, interpret=interp,
+                )
+            )
 
         def scatter_branch(ops):
             bl, u, st = ops
             masks_orig = blocked.build_masks(bit, w)
-            return blocked.blocked_insert(bl, blk, masks_orig, valid)
+            out = blocked.blocked_insert(to_logical(bl), blk, masks_orig, valid)
+            return out.reshape(blocks.shape)
 
         return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
 
     def fat_branch(ops):
         bl, u, st = ops
         new_fat, presb = fat_sweep_insert(
-            bl.reshape(NBJ, 128), u, st,
+            to_fat(bl), u, st,
             J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
             interpret=interp, with_presence=True,
         )
         present = _fat_unsort_presence(
             presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S, KJ=KJ, KBJ=KBJ
         )
-        return new_fat.reshape(nb, w), present
+        return from_fat(new_fat), present
 
     def scatter_branch(ops):
         bl, u, st = ops
+        bll = to_logical(bl)
         masks_orig = blocked.build_masks(bit, w)
-        rows = bl[jnp.minimum(blkv, nb - 1)]
+        rows = bll[jnp.minimum(blkv, nb - 1)]
         hit = jnp.all((rows & masks_orig) == masks_orig, axis=-1)
         present = hit & valid
-        return blocked.blocked_insert(bl, blk, masks_orig, valid), present
+        out = blocked.blocked_insert(bll, blk, masks_orig, valid)
+        return out.reshape(blocks.shape), present
 
     return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
 
 
 def make_sweep_insert_fn(
-    config, *, interpret: bool | None = None, with_presence: bool = False
+    config, *, interpret: bool | None = None, with_presence: bool = False,
+    storage_fat: bool = False,
 ):
     """Pure ``(blocks, keys_u8, lengths) -> blocks`` blocked insert via the
     partition sweep. Bit-identical to
@@ -1346,12 +1369,18 @@ def make_sweep_insert_fn(
     report the pre-batch state. Requires batch padding (lengths < 0) to
     sit at the TAIL of the batch (tpubloom.filter._pack_padded
     guarantees this); padded entries return False.
+
+    ``storage_fat``: blocks are the fat [NB/J, 128] view in AND out (the
+    persistent-filter layout; avoids reshape copies at the kernel
+    boundary). Batches the fat kernel cannot take reshape to the
+    logical view internally.
     """
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
 
     def insert(blocks, keys_u8, lengths):
         B = keys_u8.shape[0]
+        fat_shape = blocks.shape if storage_fat else None
         # legacy-kernel shape guards apply only when the fat sweep does
         # not take the batch (apply_blocked_updates / the presence branch
         # below prefer it)
@@ -1384,16 +1413,28 @@ def make_sweep_insert_fn(
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         if not with_presence:
-            return apply_blocked_updates(
-                blocks, blk, bit, valid, block_bits=bb, interpret=interpret
+            fat = choose_fat_params(nb, B, w)
+            if fat is not None:
+                return apply_fat_updates(
+                    blocks, blk, bit, valid,
+                    block_bits=bb, params=fat, interpret=interpret,
+                    storage_fat=storage_fat,
+                )
+            out = apply_blocked_updates(
+                blocks.reshape(nb, w) if storage_fat else blocks,
+                blk, bit, valid, block_bits=bb, interpret=interpret,
             )
+            return out.reshape(fat_shape) if storage_fat else out
         fat = choose_fat_params(nb, B, w, presence=True)
         if fat is not None:
             idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = empty slot
             return apply_fat_updates(
                 blocks, blk, bit, valid,
                 block_bits=bb, params=fat, interpret=interpret, idx=idx0,
+                storage_fat=storage_fat,
             )
+        if storage_fat:
+            blocks = blocks.reshape(nb, w)
         blk = jnp.where(valid, blk, nb)
         cols, nbits, packed = _pack_positions(bit, bb, k)
         idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = filler
@@ -1444,6 +1485,8 @@ def make_sweep_insert_fn(
         (skey,) = lax.sort((vkey,), num_keys=1)
         fused = (skey[:B] & _u32(1)) == 1
         present = jnp.where(overflow, presence_fb, fused)
+        if storage_fat:
+            new_blocks = new_blocks.reshape(fat_shape)
         return new_blocks, present
 
     return insert

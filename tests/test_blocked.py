@@ -43,7 +43,7 @@ def test_parity_with_cpu_oracle(config):
     f.insert_batch(keys)
     o.insert_batch(keys)
     # identical arrays bit for bit
-    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    np.testing.assert_array_equal(f.words_logical, o.words)
     probe = keys[:100] + _rand_keys(400, rng)
     np.testing.assert_array_equal(f.include_batch(probe), o.include_batch(probe))
 
@@ -59,7 +59,7 @@ def test_parity_hypothesis(inserted, probes):
     o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     f.insert_batch(inserted)
     o.insert_batch(inserted)
-    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    np.testing.assert_array_equal(f.words_logical, o.words)
     np.testing.assert_array_equal(
         f.include_batch(probes), o.include_batch(probes)
     )
@@ -76,7 +76,7 @@ def test_duplicate_blocks_in_batch_merge():
     o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     f.insert_batch(keys)
     o.insert_batch(keys)
-    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    np.testing.assert_array_equal(f.words_logical, o.words)
     assert f.include_batch(keys).all()
 
 
@@ -93,7 +93,7 @@ def test_padding_rows_set_no_bits(config):
     f.insert_batch([b"a"])  # bucket-padded to 64 internally
     o = CPUBlockedBloomFilter(config, use_native=False)  # ground truth stays NumPy
     o.insert_batch([b"a"])
-    np.testing.assert_array_equal(np.asarray(f.words), o.words)
+    np.testing.assert_array_equal(f.words_logical, o.words)
 
 
 def test_fpr_within_bound():
@@ -121,6 +121,49 @@ def test_serialization_roundtrip(config):
     assert g.include_batch(keys).all()
     o = CPUBlockedBloomFilter.from_bytes(config, data)
     assert o.include_batch(keys).all()
+
+
+def test_fat_storage_logical_roundtrip():
+    """Fat [NB/J, 128] device storage is the SAME row-major bytes as the
+    logical [NB, W] array: words_logical undoes the fold, to_bytes is
+    layout-agnostic, and bytes written under either layout restore into
+    the other with identical membership (filter.py fat-storage contract;
+    benchmarks/RESULTS_r3.md §2 for why the device view is fat)."""
+    from tpubloom.filter import blocked_storage_fat
+
+    fat_cfg = FilterConfig(m=1 << 20, k=7, key_len=16, block_bits=512)
+    assert blocked_storage_fat(fat_cfg)
+    # nb=4 not divisible by J=16 -> storage stays logical
+    thin_cfg = FilterConfig(m=1 << 10, k=4, key_len=16, block_bits=256)
+    assert not blocked_storage_fat(thin_cfg)
+
+    rng = np.random.default_rng(11)
+    keys = _rand_keys(1200, rng)
+    f = BlockedBloomFilter(fat_cfg)
+    f.insert_batch(keys)
+    nb, w = fat_cfg.n_blocks, fat_cfg.words_per_block
+    assert f.words.shape == (nb * w // 128, 128)
+    assert f.words_logical.shape == (nb, w)
+    # identical bytes under both views
+    assert f.words_logical.astype("<u4").tobytes() == f.to_bytes()
+
+    o = CPUBlockedBloomFilter(fat_cfg, use_native=False)
+    o.insert_batch(keys)
+    np.testing.assert_array_equal(f.words_logical, o.words)
+
+    # to_bytes/from_bytes roundtrip across device<->oracle in both directions
+    g = BlockedBloomFilter.from_bytes(fat_cfg, o.to_bytes())
+    assert g.words.shape == f.words.shape
+    np.testing.assert_array_equal(g.words_logical, o.words)
+    assert g.include_batch(keys).all()
+    o2 = CPUBlockedBloomFilter.from_bytes(fat_cfg, f.to_bytes())
+    np.testing.assert_array_equal(o2.words, o.words)
+
+    # thin config: words IS the logical view
+    t = BlockedBloomFilter(thin_cfg)
+    t.insert_batch(keys[:100])
+    assert t.words.shape == (thin_cfg.n_blocks, thin_cfg.words_per_block)
+    np.testing.assert_array_equal(np.asarray(t.words), t.words_logical)
 
 
 def test_clear(config):
@@ -182,7 +225,7 @@ def test_checkpoint_roundtrip_blocked(tmp_path):
     g = ckpt.restore(config, sink)
     assert isinstance(g, BlockedBloomFilter)
     assert g.include_batch(keys).all()
-    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    np.testing.assert_array_equal(f.words_logical, g.words_logical)
     # restoring under the flat spec must be refused (different position spec)
     import pytest as _pytest
 

@@ -36,6 +36,17 @@ def test_choose_fat_params_always_valid(log2_nb, log2_b, w, presence):
     assert 2 * J * KBJ * 128 * 4 + 4 * (S * R8 * 128 * 4) <= 12 * 1024 * 1024
 
 
+def test_choose_fat_params_rejects_128_lane_overflow():
+    """w=128 (block_bits=4096) can't fit the 1 + W (+1) update row in 128
+    lanes; choose_fat_params must return None (ADVICE r3: a forced
+    insert_path='sweep' previously hit an obscure negative-pad trace
+    error in _fat_stream instead of the legacy guard's ValueError)."""
+    assert choose_fat_params(1 << 20, 1 << 23, 128) is None
+    assert choose_fat_params(1 << 20, 1 << 23, 128, presence=True) is None
+    # w=64 insert fits (1+64 <= 128) both with and without presence
+    assert choose_fat_params(1 << 20, 1 << 23, 64) is not None
+
+
 @settings(max_examples=100, deadline=None)
 @given(
     log2_nb=st.integers(min_value=3, max_value=26),

@@ -316,6 +316,14 @@ class _FilterBase:
     def block_until_ready(self) -> None:
         self.words.block_until_ready()
 
+    @property
+    def words_logical(self) -> np.ndarray:
+        """Host copy of the storage in its LOGICAL shape — what oracles,
+        tools, and tests should compare against. For flat filters this is
+        the device shape; :class:`BlockedBloomFilter` overrides it to
+        undo the fat [NB/J, 128] device view (same row-major bytes)."""
+        return np.asarray(self.words)
+
     def _set_words(self, words) -> None:
         """Replace storage from a flat array (checkpoint restore)."""
         self.words = jnp.asarray(
@@ -416,6 +424,15 @@ class BlockedBloomFilter(_FilterBase):
     tpubloom.ops.blocked for the measured rationale and the exact spec).
     Use when raw insert/query rate matters more than the last ~fraction of
     FPR headroom at high fill; not bit-compatible with the flat layout.
+
+    Storage layout: ``self.words`` is the DEVICE array and, whenever
+    ``blocked_storage_fat(config)`` holds, uses the fat ``[NB/J, 128]``
+    view (J = 128 // words_per_block) — the SAME row-major bytes as the
+    logical ``[n_blocks, words_per_block]`` array, folded J blocks per
+    row so DMA runs at full 128-lane width (benchmarks/RESULTS_r3.md §2
+    measured 5× on this). Read ``words_logical`` for the logical shape;
+    ``to_bytes``/``from_bytes`` are layout-agnostic (row-major bytes are
+    identical under both views).
     """
 
     def __init__(self, config: FilterConfig):
@@ -471,6 +488,12 @@ class BlockedBloomFilter(_FilterBase):
         self.words, present = self._test_insert(self.words, keys_u8, lengths)
         self.n_inserted += B
         return np.asarray(present)[:B]
+
+    @property
+    def words_logical(self) -> np.ndarray:
+        return np.asarray(self.words).reshape(
+            self.config.n_blocks, self.config.words_per_block
+        )
 
     def stats(self) -> dict:
         return {

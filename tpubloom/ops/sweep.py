@@ -127,16 +127,23 @@ def auto_insert_path(
 
 
 def resolve_insert_path(
-    config, batch: int, backend: str | None = None, *, presence: bool = False
+    config, batch: int, backend: str | None = None, *, presence: bool = False,
+    n_blocks: int | None = None,
 ) -> str:
     """Resolve ``config.insert_path`` ("auto"/"sweep"/"scatter") for a
-    batch size on the current (or given) backend."""
+    batch size on the current (or given) backend. The ONE funnel for
+    every insert-path decision (single-chip, presence, and — via the
+    ``n_blocks`` override, which the sharded per-device hot loop uses to
+    pass its LOCAL row count — the shard_map paths)."""
     if config.insert_path != "auto":
         return config.insert_path
     if backend is None:
         backend = jax.default_backend()
     return auto_insert_path(
-        backend, config.n_blocks, batch, config.words_per_block,
+        backend,
+        config.n_blocks if n_blocks is None else n_blocks,
+        batch,
+        config.words_per_block,
         presence=presence,
     )
 
@@ -895,6 +902,13 @@ def choose_fat_params(
     import math
 
     w = words_per_block
+    if 1 + w + (1 if presence else 0) > 128:
+        # the update-stream row holds block id + W mask words (+ key idx
+        # for presence) in 128 lanes; w=128 (block_bits=4096) can't fit —
+        # mirror the legacy kernel's w+2>128 guard so a forced
+        # insert_path="sweep" gets the clean ValueError, not a negative-
+        # pad trace error from _fat_stream
+        return None
     J = 128 // w
     if J < 1 or w * J != 128 or nb % J:
         return None

@@ -81,16 +81,18 @@ def _route_local(config: FilterConfig, shards_per_dev: int, keys_u8, lengths):
     return local_row, owned, lens
 
 
-def _use_local_sweep(config: FilterConfig, local_rows: int, batch: int) -> bool:
+def _use_local_sweep(
+    config: FilterConfig, local_rows: int, batch: int, *,
+    presence: bool = False,
+) -> bool:
     """Resolve config.insert_path for the per-device hot loop (the local
-    row count, not the global filter, decides sweep applicability)."""
+    row count, not the global filter, decides sweep applicability) —
+    delegates to the single resolve_insert_path funnel."""
     from tpubloom.ops import sweep
 
-    if config.insert_path == "sweep":
-        return True
-    return config.insert_path == "auto" and (
-        sweep.auto_insert_path(
-            jax.default_backend(), local_rows, batch, config.words_per_block
+    return (
+        sweep.resolve_insert_path(
+            config, batch, presence=presence, n_blocks=local_rows
         )
         == "sweep"
     )

@@ -191,6 +191,12 @@ def _run_bench(platform: str) -> dict:
         "kernel_s": round(blk_kernel, 4),
         "flat_keys_per_sec": round(flat_rate),
         "e2e_keys_per_sec": round(Bh / e2e_s),
+        "e2e_note": (
+            "host-fed rate is axon-tunnel transport-bound, NOT code-bound: "
+            "H2D over this tunnel varies 0.2-20 MB/s across rounds "
+            "(r1 240k, r2 126k, r3 110k keys/s were tunnel weather); "
+            "compare split_keys_per_sec for the device-side rate"
+        ),
         "observed_fpr": fpr,
         "n_inserted": n_inserted,
     }

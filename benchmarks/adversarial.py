@@ -1,24 +1,32 @@
 #!/usr/bin/env python
-"""Adversarial-skew verification on REAL Mosaic (VERDICT r2 #9).
+"""Adversarial-skew verification on REAL Mosaic (VERDICT r2 #9, r3 #5).
 
 Interpret-mode passing is weak evidence for this kernel family (Mosaic
 has miscompiled lane/sublane patterns silently before — see
-ops/sweep.py), so this drives the actual TPU kernel:
+ops/sweep.py), so this drives the actual TPU kernels across the shapes
+the filters really use:
 
-  1. uniform 4M keys through the fat sweep — bit-exact vs the XLA
-     sorted-scatter path, fused presence replay-verified;
-  2. a duplicate-heavy batch (4M = 4096 copies of 1024 keys) — window
-     overflow must trip the host-side lax.cond fallback and still be
-     bit-exact vs scatter, presence included;
-  3. timings for both (the fallback's cost is the documented price of
-     adversarial skew).
+  * block_bits in {256, 512, 1024} — covers pack=4 (W=8, 16) AND the
+    pack=1 fallback (W=32: 1+32+1 lanes exceed the 32-lane stride);
+  * storage_fat=True (the entry path persistent filters take) and the
+    logical [NB, W] entry;
+  * uniform 4M keys (bit-exact vs the XLA sorted-scatter path, fused
+    presence replay-verified) and a duplicate-heavy batch (4096 copies
+    of 1024 keys — window overflow must trip the host-side lax.cond
+    fallback and still be bit-exact, presence included);
+  * a small-filter point (m=2^28) so choose_fat_params picks a
+    different (R8, S) corner;
+  * the fat COUNTING kernel: insert + delete vs the flat-counting
+    scatter ref, saturation included.
 
-Prints one JSON line per check. Exit code 1 on any mismatch.
+Prints one JSON line per check and writes them all to
+benchmarks/out/adversarial_r4.json. Exit code 1 on any mismatch.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -27,72 +35,165 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpubloom.config import FilterConfig
-from tpubloom.filter import make_blocked_insert_fn, make_blocked_test_insert_fn
-from tpubloom.ops import blocked
+from tpubloom.filter import make_blocked_test_insert_fn
+from tpubloom.ops import blocked, counting
+from tpubloom.ops.sweep import choose_fat_params, fat_pack
 
-LOG2M = 32
-B = 1 << 22
-config = FilterConfig(m=1 << LOG2M, k=7, key_len=16, block_bits=512)
-NB, W = config.n_blocks, config.words_per_block
-lengths = jnp.full((B,), 16, jnp.int32)
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "adversarial_r4.json")
+_rows = []
 
 
-def scatter_ref(keys):
-    blk, bit = blocked.block_positions(
-        keys, lengths, n_blocks=NB, block_bits=512, k=config.k,
-        seed=config.seed, block_hash=config.block_hash,
-    )
-    masks = blocked.build_masks(bit, W)
-    return blocked.blocked_insert(
-        jnp.zeros((NB, W), jnp.uint32), blk, masks, jnp.ones((B,), bool)
-    )
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+    # incremental write: a timeout mid-run must not lose recorded checks
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
 
 
-def main() -> int:
-    ok_all = True
-    ti = jax.jit(make_blocked_test_insert_fn(config), donate_argnums=0)
-    ref_jit = jax.jit(scatter_ref)
-
-    for name, mk in (
-        ("uniform", lambda rng: rng.integers(0, 256, (B, 16), np.uint8)),
+def _batches(B):
+    rng = np.random.default_rng(0)
+    return (
+        ("uniform", rng.integers(0, 256, (B, 16), np.uint8)),
         (
-            "duplicate-skew 4096x1024",
-            lambda rng: np.tile(
-                rng.integers(0, 256, (1024, 16), np.uint8), (B // 1024, 1)
-            ),
+            "duplicate-skew",
+            np.tile(rng.integers(0, 256, (1024, 16), np.uint8), (B // 1024, 1)),
         ),
-    ):
-        rng = np.random.default_rng(0)
-        keys = jax.device_put(mk(rng))
-        ref = ref_jit(keys)
-        ref.block_until_ready()
+    )
+
+
+def check_bits(log2m, block_bits, storage_fat, B) -> bool:
+    config = FilterConfig(m=1 << log2m, k=7, key_len=16, block_bits=block_bits)
+    NB, W = config.n_blocks, config.words_per_block
+    lengths = jnp.full((B,), 16, jnp.int32)
+    params = choose_fat_params(NB, B, W, presence=True)
+    shape = (NB * W // 128, 128) if storage_fat else (NB, W)
+
+    def scatter_ref(keys):
+        blk, bit = blocked.block_positions(
+            keys, lengths, n_blocks=NB, block_bits=block_bits, k=config.k,
+            seed=config.seed, block_hash=config.block_hash,
+        )
+        masks = blocked.build_masks(bit, W)
+        return blocked.blocked_insert(
+            jnp.zeros((NB, W), jnp.uint32), blk, masks, jnp.ones((B,), bool)
+        )
+
+    ti = jax.jit(
+        make_blocked_test_insert_fn(config, storage_fat=storage_fat),
+        donate_argnums=0,
+    )
+    ref_jit = jax.jit(scatter_ref)
+    ok_cfg = True
+    for name, kh in _batches(B):
+        keys = jax.device_put(kh)
+        ref = np.asarray(ref_jit(keys))
         t0 = time.perf_counter()
-        st, p1 = ti(jnp.zeros((NB, W), jnp.uint32), keys, lengths)
+        st, p1 = ti(jnp.zeros(shape, jnp.uint32), keys, lengths)
         n1 = int(np.asarray(p1.sum()))
         dt1 = time.perf_counter() - t0
-        bitexact = bool(jnp.array_equal(st, ref))
+        bitexact = bool(np.array_equal(np.asarray(st).reshape(NB, W), ref))
         t0 = time.perf_counter()
         st, p2 = ti(st, keys, lengths)
         n2 = int(np.asarray(p2.sum()))
         dt2 = time.perf_counter() - t0
         ok = bitexact and n1 == 0 and n2 == B
-        ok_all &= ok
-        print(
-            json.dumps(
-                {
-                    "check": name,
-                    "bit_exact_vs_scatter": bitexact,
-                    "pres_pass1": n1,
-                    "pres_pass2": n2,
-                    "expect_pass2": B,
-                    "first_pass_s": round(dt1, 3),
-                    "second_pass_s": round(dt2, 3),
-                    "ok": ok,
-                }
-            ),
-            flush=True,
+        ok_cfg &= ok
+        emit({
+            "check": f"bits m=2^{log2m} bb={block_bits} fat={storage_fat} {name}",
+            "pack": fat_pack(W, True),
+            "fat_params": params,
+            "bit_exact_vs_scatter": bitexact,
+            "pres_pass1": n1,
+            "pres_pass2": n2,
+            "expect_pass2": B,
+            "first_pass_s": round(dt1, 3),
+            "second_pass_s": round(dt2, 3),
+            "ok": ok,
+        })
+    return ok_cfg
+
+
+def check_counting(B) -> bool:
+    """Fat counting kernel vs flat-counting scatter ref on real Mosaic."""
+    config = FilterConfig(
+        m=1 << 30, k=7, key_len=16, block_bits=512, counting=True
+    )
+    NB, W = config.n_blocks, config.words_per_block
+    cpb = config.counters_per_block
+    lengths = jnp.full((B,), 16, jnp.int32)
+    from tpubloom.ops.sweep import make_sweep_counter_fn
+
+    def ref_update(blocks, keys, increment):
+        blk, cpos = blocked.block_positions(
+            keys, lengths, n_blocks=NB, block_bits=cpb, k=config.k,
+            seed=config.seed, block_hash=config.block_hash,
         )
-    return 0 if ok_all else 1
+        gpos = (blk[:, None] * cpb + cpos.astype(jnp.int32)).astype(jnp.int32)
+        vk = jnp.ones(gpos.shape, bool)
+        out = counting.counter_update(
+            blocks.reshape(-1), gpos.ravel(), vk.ravel(), increment=increment
+        )
+        return out.reshape(NB, W)
+
+    ins = jax.jit(
+        make_sweep_counter_fn(config, increment=True, storage_fat=True),
+        donate_argnums=0,
+    )
+    dele = jax.jit(
+        make_sweep_counter_fn(config, increment=False, storage_fat=True),
+        donate_argnums=0,
+    )
+    ref_ins = jax.jit(lambda b, k_: ref_update(b, k_, True))
+    ref_del = jax.jit(lambda b, k_: ref_update(b, k_, False))
+    fat_shape = (NB * W // 128, 128)
+    ok_all = True
+    for name, kh in _batches(B):
+        keys = jax.device_put(kh)
+        t0 = time.perf_counter()
+        st = ins(jnp.zeros(fat_shape, jnp.uint32), keys, lengths)
+        st = ins(st, keys, lengths)  # second insert: counters reach 2 (or sat)
+        ref = ref_ins(ref_ins(jnp.zeros((NB, W), jnp.uint32), keys), keys)
+        exact_i = bool(
+            np.array_equal(np.asarray(st).reshape(NB, W), np.asarray(ref))
+        )
+        st = dele(st, keys, lengths)
+        ref = ref_del(ref, keys)
+        exact_d = bool(
+            np.array_equal(np.asarray(st).reshape(NB, W), np.asarray(ref))
+        )
+        dt = time.perf_counter() - t0
+        ok = exact_i and exact_d
+        ok_all &= ok
+        emit({
+            "check": f"counting m=2^30 bb=512 fat=True {name}",
+            "pack": fat_pack(W, False),
+            "insert_x2_exact": exact_i,
+            "delete_exact": exact_d,
+            "total_s": round(dt, 3),
+            "ok": ok,
+        })
+    return ok_all
+
+
+def main() -> int:
+    emit({
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "note": "bit-exactness vs XLA scatter on REAL Mosaic; presence replay",
+    })
+    B = 1 << 22
+    ok = True
+    ok &= check_bits(32, 512, True, B)  # the shipping entry path, pack=4
+    ok &= check_bits(32, 512, False, B)  # logical entry
+    ok &= check_bits(32, 256, True, B)  # W=8, pack=4
+    ok &= check_bits(32, 1024, True, B)  # W=32, pack=1 fallback
+    ok &= check_bits(28, 512, True, 1 << 20)  # small filter: other (R8, S)
+    ok &= check_counting(B)
+    emit({"all_ok": ok})
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Fused-step throughput vs batch size and keygen cost (VERDICT r3 #2b:
+multi-batch amortization — bigger B amortizes the blocks stream and
+shrinks relative window slack; plus: how much of the step is benchmark
+keygen, not filter work).
+
+Variants at m=2^32, k=7, blocked512, fat storage, presence fused:
+  B in {2M, 4M, 8M}  x  keygen in {rng_bits, xor_fold}
+
+xor_fold derives each step's keys from ONE persistent random buffer by
+XOR-folding the step index into every 4-byte word — distinct uniform
+keys per step at ~1 read of the buffer instead of a full threefry pass
+(the filter still hashes all 16 bytes of every key; only the synthetic
+key SOURCE gets cheaper, which is benchmark scaffolding, not filter
+work).
+
+To-value timing, >= 8 chained steps. Writes benchmarks/out/b_sweep_r4.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import make_blocked_test_insert_fn
+
+KEY_LEN = 16
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "b_sweep_r4.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+def run(B, keygen_mode, steps=8):
+    config = FilterConfig(m=1 << 32, k=7, key_len=KEY_LEN, block_bits=512)
+    fat_rows = config.n_blocks * config.words_per_block // 128
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+    fn = make_blocked_test_insert_fn(config, storage_fat=True)
+    base = jax.random.bits(jax.random.key(99), (B, KEY_LEN // 4), jnp.uint32)
+
+    def step(state, carry, i):
+        if keygen_mode == "rng_bits":
+            keys = jax.random.bits(
+                jax.random.key(i ^ (carry & 0xFFFF)), (B, KEY_LEN), jnp.uint8
+            )
+        else:  # xor_fold
+            mixed = base ^ (
+                jnp.uint32(i) * jnp.uint32(0x9E3779B9) ^ (carry & jnp.uint32(0xFFFF))
+            )
+            keys = jax.lax.bitcast_convert_type(mixed, jnp.uint8).reshape(
+                B, KEY_LEN
+            )
+        state, present = fn(state, keys, lengths)
+        return state, jnp.sum(present.astype(jnp.uint32))
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros((fat_rows, 128), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, jnp.uint32(0), 0)
+    int(np.asarray(carry))
+    compile_s = time.perf_counter() - t0
+    state, carry = jit(state, carry, 1)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(2, 2 + steps):
+        state, carry = jit(state, carry, i)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / steps
+    emit({
+        "B": B,
+        "keygen": keygen_mode,
+        "ms_per_step": round(dt * 1e3, 2),
+        "fused_keys_per_sec": round(B / dt),
+        "compile_s": round(compile_s, 1),
+    })
+    del state, carry
+
+
+def main():
+    emit({
+        "shape": "m=2^32 k=7 blocked512 fat, fused test-and-insert",
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "timing": "to-value, 8 chained steps",
+    })
+    for B in (1 << 21, 1 << 22, 1 << 23):
+        for mode in ("rng_bits", "xor_fold"):
+            try:
+                run(B, mode)
+            except Exception as e:  # noqa: BLE001
+                emit({"B": B, "keygen": mode, "error": str(e)[:300]})
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,383 @@
+#!/usr/bin/env python
+"""Per-stage breakdown of the FAT fused sweep pipeline (VERDICT r3 #2).
+
+The round-3 profiler (profile_sweep.py) measured the legacy kernel; this
+one measures the shipping fat-row pipeline (ops.sweep.apply_fat_updates)
+at the north-star shape (m=2^32, k=7, B=4M, blocked512, fat storage),
+with TO-VALUE timing (block_until_ready can lie on this stack — see
+benchmarks/RESULTS_r3.md §1): every loop forces a host value from a
+carry that depends on all outputs, >= 16 chained steps.
+
+Stages (cumulative prefixes; deltas reported at the end):
+
+  P0 keygen       device RNG [B, 16] u8
+  P1 +hash        block_positions (3x murmur + fnv over 16B keys)
+  P2 +sort        skey + pack positions + 4-col lax.sort (presence) /
+                  3-col (insert-only)
+  P3 +masks       unpack + build_masks [B, W]
+  P4 +stream      _fat_stream ([Btot, 128] buffer) + searchsorted starts
+  P5 +kernel      fat_sweep_insert (Pallas fat grid sweep)
+  P6 full         apply_fat_updates (+ presence unsort + overflow cond)
+
+Also: kernel-only on a prebuilt stream, and lax.sort operand scaling.
+Run: timeout 1800 python benchmarks/profile_fat.py [--insert-only]
+Writes benchmarks/out/profile_fat_r4.json (one JSON object per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _fat_stream,
+    _fat_unsort_presence,
+    _fat_window_overflow,
+    _pack_positions,
+    _packed_rows,
+    _unpack_positions,
+    apply_fat_updates,
+    choose_fat_params,
+    fat_pack,
+    fat_sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 16
+PRESENCE = "--insert-only" not in sys.argv
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+PARAMS = choose_fat_params(NB, B, W, presence=PRESENCE)
+J, R8, S, KJ, KBJ = PARAMS
+PACK = fat_pack(W, PRESENCE)
+KJP = _packed_rows(KJ, PACK)
+NBJ = NB // J
+P8 = NBJ // R8
+FAT_SHAPE = (NB * W // 128, 128)
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "profile_fat_r4.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def keygen(carry, i):
+    return jax.random.bits(
+        jax.random.key(i ^ (carry & 0xFFFF)), (B, KEY_LEN), jnp.uint8
+    )
+
+
+def _positions(keys):
+    return blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+
+
+def _skey(blk, valid):
+    blkv = jnp.where(valid, blk, NB)
+    j_of = (blkv % J).astype(jnp.uint32)
+    rf_of = (blkv // J).astype(jnp.uint32)
+    return jnp.where(valid, j_of * NBJ + rf_of, _u32(J * NBJ))
+
+
+def _sorted_cols(keys):
+    blk, bit = _positions(keys)
+    valid = jnp.ones((B,), bool)
+    skey = _skey(blk, valid)
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    extra = (jnp.arange(1, B + 1, dtype=jnp.uint32),) if PRESENCE else ()
+    sorted_cols = lax.sort((skey,) + cols + extra, num_keys=1)
+    return sorted_cols, nbits, packed
+
+
+def _stream(keys):
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    ss = sorted_cols[0]
+    pcols = sorted_cols[1:-1] if PRESENCE else sorted_cols[1:]
+    bit_sorted = _unpack_positions(pcols, BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    idx_sorted = sorted_cols[-1] if PRESENCE else None
+    return _fat_stream(
+        ss, masks, idx_sorted, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=W,
+        pack=PACK,
+    )
+
+
+def p0(state, carry, i):
+    keys = keygen(carry, i)
+    return state, jnp.sum(keys, dtype=jnp.uint32)
+
+
+def p1(state, carry, i):
+    keys = keygen(carry, i)
+    blk, bit = _positions(keys)
+    return state, jnp.sum(blk.astype(jnp.uint32)) + jnp.sum(bit)
+
+
+def p2(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, _, _ = _sorted_cols(keys)
+    return state, sum(jnp.sum(c) for c in sorted_cols)
+
+
+def p3(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    pcols = sorted_cols[1:-1] if PRESENCE else sorted_cols[1:]
+    bit_sorted = _unpack_positions(pcols, BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return state, jnp.sum(masks) + jnp.sum(sorted_cols[0])
+
+
+def p4(state, carry, i):
+    keys = keygen(carry, i)
+    upd, starts = _stream(keys)
+    return state, jnp.sum(upd, dtype=jnp.uint32) + jnp.sum(starts).astype(
+        jnp.uint32
+    )
+
+
+def p5(state, carry, i):
+    keys = keygen(carry, i)
+    upd, starts = _stream(keys)
+    if PRESENCE:
+        new_fat, presb = fat_sweep_insert(
+            state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+            with_presence=True, pack=PACK,
+        )
+        return new_fat, jnp.sum(presb, dtype=jnp.uint32)
+    new_fat = fat_sweep_insert(
+        state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W, pack=PACK
+    )
+    return new_fat, jnp.sum(new_fat[:: max(1, FAT_SHAPE[0] // 64)], dtype=jnp.uint32)
+
+
+def p6(state, carry, i):
+    keys = keygen(carry, i)
+    blk, bit = _positions(keys)
+    valid = jnp.ones((B,), bool)
+    if PRESENCE:
+        idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+        new_fat, present = apply_fat_updates(
+            state, blk, bit, valid, block_bits=BB, params=PARAMS,
+            idx=idx0, storage_fat=True,
+        )
+        return new_fat, jnp.sum(present.astype(jnp.uint32))
+    new_fat = apply_fat_updates(
+        state, blk, bit, valid, block_bits=BB, params=PARAMS, storage_fat=True,
+    )
+    return new_fat, jnp.sum(new_fat[:: max(1, FAT_SHAPE[0] // 64)], dtype=jnp.uint32)
+
+
+def run(name, step, steps=STEPS):
+    state0 = jnp.zeros(FAT_SHAPE, jnp.uint32)
+    jit = jax.jit(step, donate_argnums=(0,))
+    t0 = time.perf_counter()
+    state, carry = jit(state0, _u32(0), 0)
+    int(np.asarray(carry))  # to-value: compile + first step
+    compile_s = time.perf_counter() - t0
+    state, carry = jit(state, carry, 1)
+    int(np.asarray(carry))  # warm second call (jit cache, donation path)
+    t0 = time.perf_counter()
+    for i in range(2, 2 + steps):
+        state, carry = jit(state, carry, i)
+    val = int(np.asarray(carry))  # ONE host fetch after the chained loop
+    dt = (time.perf_counter() - t0) / steps
+    emit({
+        "stage": name,
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+        "compile_s": round(compile_s, 1),
+        "carry": val & 0xFFFF,
+    })
+    del state, carry
+    return dt
+
+
+def kernel_only():
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    upd, starts = jax.jit(_stream)(keys)
+    int(np.asarray(starts[0]))
+
+    def step(state, upd, starts):
+        if PRESENCE:
+            new_fat, presb = fat_sweep_insert(
+                state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+                with_presence=True, pack=PACK,
+            )
+            return new_fat, jnp.sum(presb, dtype=jnp.uint32)
+        new_fat = fat_sweep_insert(
+            state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W, pack=PACK
+        )
+        return new_fat, jnp.sum(
+            new_fat[:: max(1, FAT_SHAPE[0] // 64)], dtype=jnp.uint32
+        )
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros(FAT_SHAPE, jnp.uint32)
+    state, carry = jit(state, upd, starts)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, carry = jit(state, upd, starts)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    emit({
+        "stage": f"kernel_only(prebuilt stream, presence={PRESENCE})",
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+    })
+
+
+def unsort_only():
+    """The presence unsort in isolation (single-column vkey sort)."""
+    if not PRESENCE:
+        return
+    P = P8 // S
+    presb = jax.random.bits(jax.random.key(3), (P * KJP, 128), jnp.uint32)
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    _, starts = jax.jit(_stream)(keys)
+
+    def step(presb, carry):
+        pres = _fat_unsort_presence(
+            presb ^ carry, starts, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
+            KJ=KJP, KBJ=KBJ, pack=PACK,
+        )
+        return jnp.sum(pres.astype(jnp.uint32))
+
+    jit = jax.jit(step)
+    carry = jit(presb, _u32(0))
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        carry = jit(presb, carry)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    emit({
+        "stage": "unsort_only(vkey single-col sort)",
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+        "rows_sorted": J * P8 * PACK * KJP,
+    })
+
+
+def sort_scaling():
+    cols = [jax.random.bits(jax.random.fold_in(jax.random.key(7), i), (B,),
+                            jnp.uint32) for i in range(5)]
+    for nc in (1, 2, 3, 4):
+        def step(carry, nc=nc):
+            key0 = cols[0] ^ carry
+            out = lax.sort(tuple([key0] + cols[1:nc]), num_keys=1)
+            return sum(jnp.sum(c) for c in out).astype(jnp.uint32)
+
+        jit = jax.jit(step)
+        carry = jit(_u32(0))
+        int(np.asarray(carry))
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            carry = jit(carry)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({
+            "stage": f"lax.sort {nc} u32 cols, B={B >> 20}M",
+            "ms_per_step": round(dt * 1e3, 3),
+            "ns_per_key": round(dt / B * 1e9, 3),
+        })
+
+
+def hash_parts():
+    """Hash front-end in isolation: murmur passes vs position assembly."""
+    from tpubloom.ops import hashing
+
+    def h_only(carry, i):
+        keys = keygen(carry, i)
+        h = hashing.murmur3_32(keys, lengths, config.seed)
+        return jnp.sum(h)
+
+    def pos_only(carry, i):
+        keys = keygen(carry, i)
+        blk, bit = _positions(keys)
+        return jnp.sum(blk.astype(jnp.uint32)) + jnp.sum(bit)
+
+    for name, fn in [("murmur3 x1", h_only), ("block_positions", pos_only)]:
+        jit = jax.jit(fn)
+        carry = jit(_u32(0), 0)
+        int(np.asarray(carry))
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            carry = jit(carry, i)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({
+            "stage": f"hash:{name}",
+            "ms_per_step": round(dt * 1e3, 3),
+            "ns_per_key": round(dt / B * 1e9, 3),
+        })
+
+
+def main():
+    emit({
+        "shape": {
+            "m": config.m, "k": K, "B": B, "block_bits": BB, "n_blocks": NB,
+            "W": W, "J": J, "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ, "pack": PACK,
+            "presence": PRESENCE,
+            "platform": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "timing": "to-value (int(np.asarray(carry)) after chained loop)",
+        }
+    })
+    prev = 0.0
+    deltas = {}
+    for name, fn in [
+        ("P0 keygen", p0),
+        ("P1 +hash", p1),
+        ("P2 +sort", p2),
+        ("P3 +masks", p3),
+        ("P4 +stream", p4),
+        ("P5 +kernel", p5),
+        ("P6 full fused", p6),
+    ]:
+        dt = run(name, fn)
+        deltas[name] = dt - prev
+        prev = dt
+    emit({
+        "deltas_ms": {k: round(v * 1e3, 3) for k, v in deltas.items()},
+        "fused_keys_per_sec": round(B / prev),
+    })
+    kernel_only()
+    unsort_only()
+    sort_scaling()
+    hash_parts()
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

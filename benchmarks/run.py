@@ -100,7 +100,9 @@ def config2(scale: float, layout: str = "flat") -> dict:
             f.insert_arrays(ku8, lengths)  # device-resident keys, no H2D
         done += b
         seed += 1
-    f.block_until_ready()
+    # to-value fence: block_until_ready can return early on this stack
+    # (benchmarks/RESULTS_r3.md §1)
+    int(np.asarray(f.words.ravel()[0]))
     t_insert = time.perf_counter() - t0
     # mixed-hit queries: half present (reuse seed 0 batch), half absent —
     # all operands stay on device
@@ -114,7 +116,7 @@ def config2(scale: float, layout: str = "flat") -> dict:
         acc = hits if acc is None else acc ^ hits
         qdone += B
     if acc is not None:
-        acc.block_until_ready()
+        int(np.asarray(jnp.sum(acc.astype(jnp.uint32))))  # to-value fence
     t_query = time.perf_counter() - t0
     return {
         "config": 2,

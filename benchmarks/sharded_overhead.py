@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""shard_map overhead on real hardware (VERDICT r3 #3 "done =" clause).
+
+Compares, on the ONE real chip, the same total work:
+
+  * single-chip fat-sweep insert on a BlockedBloomFilter of m total bits
+  * ShardedBloomFilter on a 1-device mesh with 2 logical shards (routing
+    hash + shard_map + per-device fat kernel + psum-OR query assembly)
+
+Any difference is the sharded machinery's cost: the routing murmur pass,
+the owned-mask plumbing, shard_map tracing overhead, and the psum (a
+no-op collective on a 1-device mesh). Device-generated keys, to-value
+timing. Writes benchmarks/out/sharded_overhead_r4.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import make_blocked_insert_fn, make_blocked_query_fn
+from tpubloom.parallel import sharded as sh
+
+LOG2M = 30  # 128 MiB of bits -> 2 x 64 MiB shards
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+OUT_PATH = os.path.join(
+    os.path.dirname(__file__), "out", "sharded_overhead_r4.json"
+)
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+def _measure(step, state0, steps=STEPS):
+    jit = jax.jit(step, donate_argnums=(0,))
+    t0 = time.perf_counter()
+    state, carry = jit(state0, jnp.uint32(0), 0)
+    int(np.asarray(carry))
+    compile_s = time.perf_counter() - t0
+    state, carry = jit(state, carry, 1)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(2, 2 + steps):
+        state, carry = jit(state, carry, i)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / steps
+    del state, carry
+    return dt, compile_s
+
+
+def keygen(carry, i):
+    return jax.random.bits(
+        jax.random.key(i ^ (carry & 0xFFFF)), (B, KEY_LEN), jnp.uint8
+    )
+
+
+def main():
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+    # single chip, fat storage
+    cfg1 = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+    ins1 = make_blocked_insert_fn(cfg1, storage_fat=True)
+    qry1 = make_blocked_query_fn(cfg1, storage_fat=True)
+    fat_shape = (cfg1.n_blocks * cfg1.words_per_block // 128, 128)
+
+    def step1(state, carry, i):
+        keys = keygen(carry, i)
+        state = ins1(state, keys, lengths)
+        hits = qry1(state, keys, lengths)
+        return state, jnp.sum(hits.astype(jnp.uint32))
+
+    dt1, c1 = _measure(step1, jnp.zeros(fat_shape, jnp.uint32))
+    emit({
+        "variant": "single-chip fat insert+query",
+        "m": cfg1.m, "B": B,
+        "ms_per_step": round(dt1 * 1e3, 2),
+        "pairs_per_sec": round(B / dt1),
+        "compile_s": round(c1, 1),
+    })
+
+    # 1-device mesh, 2 logical shards, same total m
+    cfg2 = FilterConfig(
+        m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512, shards=2
+    )
+    mesh = sh.make_mesh(2, jax.devices()[:1])
+    ins2 = sh.make_sharded_blocked_insert_fn(cfg2, mesh)
+    qry2 = sh.make_sharded_blocked_query_fn(cfg2, mesh)
+    fat_local = cfg2.n_blocks_per_shard * cfg2.words_per_block // 128
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    words0 = jax.device_put(
+        jnp.zeros((2, fat_local, 128), jnp.uint32),
+        NamedSharding(mesh, P(sh.AXIS, None, None)),
+    )
+
+    def step2(state, carry, i):
+        keys = keygen(carry, i)
+        state = ins2(state, keys, lengths)
+        hits = qry2(state, keys, lengths)
+        return state, jnp.sum(hits.astype(jnp.uint32))
+
+    dt2, c2 = _measure(step2, words0)
+    emit({
+        "variant": "sharded (1-device mesh, 2 shards) insert+query",
+        "m": cfg2.m, "B": B,
+        "ms_per_step": round(dt2 * 1e3, 2),
+        "pairs_per_sec": round(B / dt2),
+        "compile_s": round(c2, 1),
+        "overhead_vs_single_pct": round((dt2 / dt1 - 1) * 100, 1),
+        "fat_local_storage": True,
+    })
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,71 @@ def test_class_surface_and_roundtrip(config):
     )
 
 
+def test_fat_counting_kernel_parity(config):
+    """The fat-row counting kernel (interpret) == flat-counting fallback
+    at a shape choose_fat_params accepts, including within-batch
+    duplicate skew, saturation, and delete floor — and via BOTH the
+    logical and the fat storage entry (storage_fat=True is what the
+    filter class actually uses)."""
+    from tpubloom.ops.sweep import choose_fat_params
+
+    B = 1024
+    assert choose_fat_params(config.n_blocks, B, config.words_per_block)
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 256, (B, 16), dtype=np.uint8)
+    # heavy duplication: 1/4 of the batch is one repeated key
+    base[: B // 4] = base[0]
+    keys = jnp.asarray(base)
+    lengths = jnp.full((B,), 16, jnp.int32)
+    fb_i, sw_i = _pair(config, True)
+    fb_d, sw_d = _pair(config, False)
+    a = fb_i(_zeros(config), keys, lengths)
+    b = sw_i(_zeros(config), keys, lengths)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    a = fb_d(a, keys, lengths)
+    b = sw_d(b, keys, lengths)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.asarray(a).any()  # floor back to empty
+
+    # fat storage entry: same bytes through the [NB/J, 128] view
+    sw_fat = jax.jit(
+        make_sweep_counter_fn(config, increment=True, interpret=True,
+                              storage_fat=True)
+    )
+    J = 128 // config.words_per_block
+    fat0 = jnp.zeros(
+        (config.n_blocks // J, 128), jnp.uint32
+    )
+    c = sw_fat(fat0, keys, lengths)
+    expect = np.asarray(fb_i(_zeros(config), keys, lengths))
+    np.testing.assert_array_equal(
+        np.asarray(c).reshape(expect.shape), expect
+    )
+
+
+def test_blocked_counting_class_uses_fat_storage(config):
+    """BlockedCountingBloomFilter holds fat [NB/J, 128] device storage
+    (round-4 change mirroring BlockedBloomFilter), words_logical undoes
+    it, and to_bytes/from_bytes stay layout-agnostic."""
+    from tpubloom.filter import blocked_storage_fat
+
+    assert blocked_storage_fat(config)
+    f = BlockedCountingBloomFilter(config)
+    nb, w = config.n_blocks, config.words_per_block
+    assert f.words.shape == (nb * w // 128, 128)
+    assert f.words_logical.shape == (nb, w)
+    rng = np.random.default_rng(8)
+    keys = [rng.bytes(16) for _ in range(600)]
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    assert f.words_logical.astype("<u4").tobytes() == f.to_bytes()
+    g = BlockedCountingBloomFilter.from_bytes(config, f.to_bytes())
+    assert g.words.shape == f.words.shape
+    assert g.include_batch(keys).all()
+    g.delete_batch(keys)
+    assert not g.include_batch(keys).any()
+
+
 def test_query_requires_all_counters(config):
     # membership requires ALL k counters nonzero — craft the array by
     # hand: with every counter of the key set, membership holds; zeroing

@@ -4,7 +4,12 @@ restricted to {512, 1024})."""
 
 from hypothesis import given, settings, strategies as st
 
-from tpubloom.ops.sweep import choose_fat_params, sweep_applicable
+from tpubloom.ops.sweep import (
+    _packed_rows,
+    choose_fat_params,
+    fat_pack,
+    sweep_applicable,
+)
 
 
 @settings(max_examples=300, deadline=None)
@@ -29,11 +34,22 @@ def test_choose_fat_params_always_valid(log2_nb, log2_b, w, presence):
     assert KBJ % 8 == 0 and KBJ >= KJ
     lam = batch * R8 // nb
     assert KJ >= min(1024, lam), "window must cover expected occupancy"
+    bodies = S * J * fat_pack(w, presence)
     if presence:
         assert S * R8 <= 512, "presence kernels cap the tile at 512 fat rows"
-        assert S * J <= 128, "presence slot columns must fit 128 lanes"
-    # VMEM budget: windows + in/out/pres tiles with headroom
-    assert 2 * J * KBJ * 128 * 4 + 4 * (S * R8 * 128 * 4) <= 12 * 1024 * 1024
+        assert bodies <= 64, (
+            "presence S*J*PACK unroll must fit Mosaic's scoped-VMEM stack "
+            "(measured: OOM at 128 bodies)"
+        )
+        assert S * J * fat_pack(w, presence) <= 128, "slot columns fit 128 lanes"
+    else:
+        assert bodies <= 256, "insert-only unroll bound (validated at 256)"
+    # VMEM budget: windows (PACKED rows) + in/out/pres tiles with headroom
+    sup_rows = _packed_rows(KBJ, fat_pack(w, presence))
+    assert (
+        2 * J * sup_rows * 128 * 4 + 4 * (S * R8 * 128 * 4)
+        <= 12 * 1024 * 1024
+    )
 
 
 def test_choose_fat_params_rejects_128_lane_overflow():

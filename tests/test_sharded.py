@@ -200,7 +200,7 @@ def test_blocked_parity_vs_oracle(blk_cfg8):
     o = ShardedCPURef(blk_cfg8)
     f.insert_batch(keys)
     o.insert_batch(keys)
-    dev = np.asarray(f.words)  # [shards, n_blocks_local, W]
+    dev = f.words_logical  # [shards, n_blocks_local, W]
     for s in range(blk_cfg8.shards):
         np.testing.assert_array_equal(dev[s], o.filters[s].words)
     probe = keys[:100] + _rand_keys(400, rng)
@@ -248,6 +248,67 @@ def test_blocked_sweep_path_in_shard_map():
     assert f.include_batch(keys).all()
     g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
     g.insert_batch(keys)
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+def test_fat_sweep_path_in_shard_map():
+    """Forced sweep with a batch big enough that the per-device hot loop
+    resolves to the FAT-row kernel (choose_fat_params accepts the
+    local shape at B/n_dev) — bit-identical to the scatter path and the
+    fat per-shard storage holds (VERDICT r3 #3: the sharded path must
+    run the fat kernel, not the legacy narrow-tile one)."""
+    from tpubloom.ops.sweep import choose_fat_params
+    from tpubloom.parallel.sharded import local_blocked_storage_fat
+
+    cfg = FilterConfig(
+        m=1 << 25, k=5, key_len=16, block_bits=512, shards=8,
+        insert_path="sweep",
+    )
+    assert local_blocked_storage_fat(cfg)
+    local_rows = cfg.n_blocks_per_shard  # 1 shard-row per device on 8 devs
+    B = 4096
+    assert choose_fat_params(
+        local_rows, max(1, B // 8), cfg.words_per_block
+    ) is not None, "test shape must exercise the fat kernel"
+    rng = np.random.default_rng(30)
+    keys = [rng.bytes(16) for _ in range(B)]
+    f = ShardedBloomFilter(cfg, mesh=make_mesh(8))
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+    # fat per-shard storage shape
+    nbl, w = cfg.n_blocks_per_shard, cfg.words_per_block
+    assert np.asarray(f.words).shape == (8, nbl * w // 128, 128)
+    g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
+    g.insert_batch(keys)
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    probe = keys[:100] + [rng.bytes(16) for _ in range(400)]
+    np.testing.assert_array_equal(f.include_batch(probe), g.include_batch(probe))
+
+
+def test_fat_counting_sweep_path_in_shard_map():
+    """Counting twin of test_fat_sweep_path_in_shard_map: the per-device
+    hot loop runs the FAT counting kernel, counter-identical to the
+    scatter path including deletes (VERDICT r3 #3/#4)."""
+    from tpubloom.ops.sweep import choose_fat_params
+
+    cfg = FilterConfig(
+        m=1 << 25, k=5, key_len=16, block_bits=512, shards=8,
+        counting=True, insert_path="sweep",
+    )
+    local_rows = cfg.n_blocks_per_shard
+    B = 4096
+    assert choose_fat_params(
+        local_rows, max(1, B // 8), cfg.words_per_block
+    ) is not None
+    rng = np.random.default_rng(31)
+    keys = [rng.bytes(16) for _ in range(B)]
+    f = ShardedBloomFilter(cfg, mesh=make_mesh(8))
+    f.insert_batch(keys)
+    f.delete_batch(keys[: B // 4])
+    assert f.include_batch(keys[B // 4 :]).all()
+    g = ShardedBloomFilter(cfg.replace(insert_path="scatter"), mesh=make_mesh(8))
+    g.insert_batch(keys)
+    g.delete_batch(keys[: B // 4])
     np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
 
 

@@ -377,9 +377,7 @@ def restore(
         import jax.numpy as jnp
 
         f = BlockedCountingBloomFilter(config)
-        f.words = jnp.asarray(words).reshape(
-            config.n_blocks, config.words_per_block
-        )
+        f.words = jnp.asarray(words).reshape(f.words.shape)
     elif config.counting:
         from tpubloom.filter import CountingBloomFilter
 

@@ -114,13 +114,22 @@ def blocked_storage_fat(config: FilterConfig) -> bool:
     """Whether the persistent blocked storage uses the fat [NB/J, 128]
     view (the SAME row-major bytes as [NB, W]): XLA's tiled HBM layouts
     make narrow-lane arrays both slow to DMA and expensive to reshape,
-    so every filter that can holds its device array fat."""
+    so every filter that can holds its device array fat. Applies to both
+    plain-blocked and blocked-counting layouts (the fat counting sweep
+    ships since round 4)."""
     w = config.words_per_block
-    return (
-        not config.counting
-        and 128 % w == 0
-        and config.n_blocks % (128 // w) == 0
-    )
+    return 128 % w == 0 and config.n_blocks % (128 // w) == 0
+
+
+def blocked_device_shape(config: FilterConfig) -> tuple[int, int]:
+    """Device-array shape for blocked storage (plain or counting): the
+    fat [NB*W/128, 128] view when :func:`blocked_storage_fat` holds,
+    else the logical [NB, W]. The ONE place the fat geometry is spelled
+    out for single-chip filters."""
+    nb, w = config.n_blocks, config.words_per_block
+    if blocked_storage_fat(config):
+        return (nb * w // 128, 128)
+    return (nb, w)
 
 
 def make_blocked_insert_fn(config: FilterConfig, *, storage_fat: bool = False):
@@ -149,15 +158,19 @@ def make_blocked_insert_fn(config: FilterConfig, *, storage_fat: bool = False):
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
-        out = blocked.blocked_insert(
-            blocks.reshape(nb, w) if storage_fat else blocks, blk, masks, valid
-        )
-        return out.reshape(blocks.shape) if storage_fat else out
+        if storage_fat:
+            # scatter straight into the fat view (a [NB, W] <-> fat
+            # reshape is a real copy on TPU; the lane fold is O(B))
+            frow, m128 = blocked.fat_fold_masks(blk, masks, 128 // w)
+            return blocked.blocked_insert(blocks, frow, m128, valid)
+        return blocked.blocked_insert(blocks, blk, masks, valid)
 
     return insert
 
 
-def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
+def make_blocked_counter_fn(
+    config: FilterConfig, *, increment: bool, storage_fat: bool = False
+):
     """Pure ``(blocks[NB,W], keys_u8, lengths) -> blocks`` update for the
     BLOCKED counting layout: all k 4-bit counters of a key live in one
     block (block_bits bits = block_bits/4 counters), so the sweep path
@@ -168,6 +181,8 @@ def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
     counting layout at positions ``blk * counters_per_block + c`` —
     which is exactly what the non-sweep fallback (and the CPU oracle)
     computes via ops.counting.counter_update on the raveled array.
+    ``storage_fat``: blocks are the fat [NB/J, 128] view in and out
+    (same raveled bytes, so the flat fallback is layout-agnostic).
     """
     nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
@@ -185,7 +200,7 @@ def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
                     )
             else:
                 return sweep.make_sweep_counter_fn(
-                    config, increment=increment
+                    config, increment=increment, storage_fat=storage_fat
                 )(blocks, keys_u8, lengths)
         valid = lengths >= 0
         blk, cpos = blocked.block_positions(
@@ -197,14 +212,18 @@ def make_blocked_counter_fn(config: FilterConfig, *, increment: bool):
         flat = counting.counter_update(
             blocks.reshape(-1), gpos.ravel(), valid_k.ravel(), increment=increment
         )
-        return flat.reshape(nb, w)
+        return flat.reshape(blocks.shape)
 
     return update
 
 
-def make_blocked_counting_query_fn(config: FilterConfig):
+def make_blocked_counting_query_fn(
+    config: FilterConfig, *, storage_fat: bool = False
+):
     """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked-counting
-    membership: one row gather per key + all-counters-nonzero test."""
+    membership: one row gather per key + all-counters-nonzero test.
+    With ``storage_fat`` the gather reads fat [NB/J, 128] rows directly
+    (row = blk // J, lane group blk % J), like the plain blocked query."""
     nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
 
@@ -213,7 +232,9 @@ def make_blocked_counting_query_fn(config: FilterConfig):
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
-        return counting.blocked_counting_membership(blocks, blk, cpos)
+        if not storage_fat:
+            return counting.blocked_counting_membership(blocks, blk, cpos)
+        return counting.fat_blocked_counting_membership(blocks, blk, cpos, w)
 
     return query
 
@@ -251,10 +272,11 @@ def make_blocked_test_insert_fn(config: FilterConfig, *, storage_fat: bool = Fal
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
         )
         masks = blocked.build_masks(bit, w)
-        bl = blocks.reshape(nb, w) if storage_fat else blocks
-        present = blocked.blocked_query(bl, blk, masks) & valid
-        out = blocked.blocked_insert(bl, blk, masks, valid)
-        return (out.reshape(blocks.shape) if storage_fat else out), present
+        if storage_fat:
+            blk, masks = blocked.fat_fold_masks(blk, masks, 128 // w)
+        present = blocked.blocked_query(blocks, blk, masks) & valid
+        out = blocked.blocked_insert(blocks, blk, masks, valid)
+        return out, present
 
     return test_insert
 
@@ -452,12 +474,7 @@ class BlockedBloomFilter(_FilterBase):
         # (benchmarks/RESULTS_r3.md) — so the persistent array stays fat
         # and every kernel/gather reads it natively
         self._fat = blocked_storage_fat(config)
-        shape = (
-            (config.n_blocks * config.words_per_block // 128, 128)
-            if self._fat
-            else (config.n_blocks, config.words_per_block)
-        )
-        self.words = jnp.zeros(shape, jnp.uint32)
+        self.words = jnp.zeros(blocked_device_shape(config), jnp.uint32)
         self._insert = jax.jit(
             make_blocked_insert_fn(config, storage_fat=self._fat),
             donate_argnums=0,
@@ -541,16 +558,31 @@ class BlockedCountingBloomFilter(_FilterBase):
         if config.m >= (1 << 31):
             raise ValueError("counting filters support m < 2^31")
         super().__init__(config, 0)  # storage is 2-D
-        self.words = jnp.zeros(
-            (config.n_blocks, config.words_per_block), jnp.uint32
-        )
+        # fat [NB/J, 128] storage where possible, like BlockedBloomFilter
+        # (same row-major bytes as [NB, W]; 128-lane DMA tier)
+        self._fat = blocked_storage_fat(config)
+        self.words = jnp.zeros(blocked_device_shape(config), jnp.uint32)
         self._insert = jax.jit(
-            make_blocked_counter_fn(config, increment=True), donate_argnums=0
+            make_blocked_counter_fn(
+                config, increment=True, storage_fat=self._fat
+            ),
+            donate_argnums=0,
         )
         self._delete = jax.jit(
-            make_blocked_counter_fn(config, increment=False), donate_argnums=0
+            make_blocked_counter_fn(
+                config, increment=False, storage_fat=self._fat
+            ),
+            donate_argnums=0,
         )
-        self._query = jax.jit(make_blocked_counting_query_fn(config))
+        self._query = jax.jit(
+            make_blocked_counting_query_fn(config, storage_fat=self._fat)
+        )
+
+    @property
+    def words_logical(self) -> np.ndarray:
+        return np.asarray(self.words).reshape(
+            self.config.n_blocks, self.config.words_per_block
+        )
 
     def delete_batch(self, keys: Sequence[bytes | str]) -> None:
         keys_u8, lengths, B = self._pack_padded(keys)
@@ -578,9 +610,7 @@ class BlockedCountingBloomFilter(_FilterBase):
     ) -> "BlockedCountingBloomFilter":
         f = cls(config)
         arr = np.frombuffer(data, dtype="<u4").astype(np.uint32)
-        f.words = jnp.asarray(
-            arr.reshape(f.config.n_blocks, f.config.words_per_block)
-        )
+        f.words = jnp.asarray(arr.reshape(f.words.shape))
         return f
 
 

@@ -142,6 +142,25 @@ def build_masks(bit: jnp.ndarray, words_per_block: int) -> jnp.ndarray:
     return mask  # [B, W]
 
 
+def fat_fold_masks(
+    blk: jnp.ndarray, masks: jnp.ndarray, J: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Translate (block id, [B, W] mask) pairs to the fat [NB/J, 128]
+    view: returns ``(fat_row[B], masks128[B, 128])`` with each mask
+    placed at lane group ``blk % J``. Lets the scatter/gather fallbacks
+    operate on fat storage DIRECTLY — a [NB, W] <-> fat reshape is a
+    real ~26 ms copy at m=2^32 on TPU (benchmarks/RESULTS_r3.md §2),
+    while this fold is O(B) VPU work. ``blocked_insert``/``blocked_query``
+    accept the folded pair unchanged (they are generic over row width;
+    distinct blocks sharing a fat row merge by OR at disjoint lanes).
+    """
+    B, w = masks.shape
+    lane = lax.broadcasted_iota(jnp.int32, (B, 128), 1)
+    sel = (lane // w) == (blk % J).astype(jnp.int32)[:, None]
+    rep = jnp.concatenate([masks] * J, axis=1)  # [B, 128], chunk j = masks
+    return (blk // J).astype(jnp.int32), jnp.where(sel, rep, _u32(0))
+
+
 def blocked_insert(
     blocks: jnp.ndarray, blk: jnp.ndarray, masks: jnp.ndarray, valid: jnp.ndarray
 ) -> jnp.ndarray:

@@ -110,3 +110,21 @@ def blocked_counting_membership(
     vals = jnp.take_along_axis(rows, word, axis=-1)
     cnt = (vals >> nib) & _u32(15)
     return jnp.all(cnt > 0, axis=-1)
+
+
+def fat_blocked_counting_membership(
+    blocks_fat: jnp.ndarray, blk: jnp.ndarray, cpos: jnp.ndarray, w: int
+) -> jnp.ndarray:
+    """Blocked-counting membership against the FAT [NB/J, 128] counter
+    view: gather the fat row (``blk // J``), offset the word index into
+    lane group ``blk % J`` — same nibble decode as
+    :func:`blocked_counting_membership`, shared by the single-chip and
+    sharded fat query paths."""
+    J = 128 // w
+    rows128 = blocks_fat[(blk // J).astype(jnp.int32)]  # [B, 128]
+    lane0 = ((blk % J) * w).astype(jnp.int32)[:, None]
+    word = lane0 + (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k]
+    nib = (cpos & jnp.uint32(7)) * jnp.uint32(4)
+    vals = jnp.take_along_axis(rows128, word, axis=1)
+    cnt = (vals >> nib) & _u32(15)
+    return jnp.all(cnt > 0, axis=-1)

@@ -742,15 +742,21 @@ def apply_counter_updates(
 
 
 def make_sweep_counter_fn(
-    config, *, increment: bool, interpret: bool | None = None
+    config, *, increment: bool, interpret: bool | None = None,
+    storage_fat: bool = False,
 ):
     """Pure ``(blocks[NB,W], keys_u8, lengths) -> blocks`` blocked-counting
     update (insert = saturating +1 per counter occurrence, delete =
     flooring -1) via the partition sweep. Bit-identical to the flat
     counting kernel applied at positions ``blk * counters_per_block + c``
     (tpubloom.filter.make_blocked_counter_fn's fallback path).
+
+    Prefers the fat-row counting kernel when the shape qualifies (the
+    128-lane DMA tier — benchmarks/RESULTS_r3.md §2); the legacy
+    [NB, W]-tile kernel is the fallback. ``storage_fat``: blocks are the
+    fat [NB/J, 128] view in and out.
     """
-    nb, cpb = config.n_blocks, config.counters_per_block
+    nb, cpb, w = config.n_blocks, config.counters_per_block, config.words_per_block
     k, seed, bh = config.k, config.seed, config.block_hash
 
     def update(blocks, keys_u8, lengths):
@@ -759,11 +765,20 @@ def make_sweep_counter_fn(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
-        return apply_counter_updates(
-            blocks, blk, cpos, valid,
+        fat = choose_fat_params(nb, keys_u8.shape[0], w)
+        if fat is not None:
+            return apply_fat_counter_updates(
+                blocks, blk, cpos, valid,
+                counters_per_block=cpb, k=k, increment=increment,
+                params=fat, interpret=interpret, storage_fat=storage_fat,
+            )
+        out = apply_counter_updates(
+            blocks.reshape(nb, w) if storage_fat else blocks,
+            blk, cpos, valid,
             counters_per_block=cpb, k=k, increment=increment,
             interpret=interpret,
         )
+        return out.reshape(blocks.shape) if storage_fat else out
 
     return update
 
@@ -938,13 +953,32 @@ def choose_fat_params(
         for s in (8, 4, 2, 1):
             if P8 % s or s * R8 > cap or P8 // s < 2:
                 continue
-            if presence and s * J > 128:
-                # presence slot values ride column t*J + j of a
-                # 128-lane tile
+            # Mosaic's scoped-VMEM stack grows with the fully-unrolled
+            # S*J*PACK inner-body count AND each presence body's
+            # [KJP, R8] oh/G matmul operands. Measured on v5e (r4
+            # probes, benchmarks/out/adversarial_r4.json): presence
+            # compiles at 64 bodies with bodies*KJP*R8 <= 1.05M
+            # (the shipping bb=512 shape) but OOMs at 128 bodies
+            # (18.0-19.6M scoped requests) or at 64 bodies with
+            # bodies*KJP*R8 = 2.1M (bb=256 J=16 R8=512). Insert-only
+            # bodies are lighter — 256 validated. The presence bound
+            # also keeps slot columns (t*J+j)*pack+u within the
+            # 128-lane presence tile.
+            pk = fat_pack(w, presence)
+            bodies = s * J * pk
+            if bodies > (64 if presence else 256):
+                continue
+            if presence and bodies * _packed_rows(KJ, pk) * R8 > 1_100_000:
                 continue
             kbj = ((lam * s + KJ + 64 + 7) // 8) * 8
             # scoped-VMEM estimate: double-buffered windows + block tiles
-            if 2 * J * kbj * 128 * 4 + 4 * (s * R8 * 128 * 4) <= 9 * 1024 * 1024:
+            # (the window buffers hold PACKED rows — 4 updates per
+            # 128-lane row when the fields fit a 32-lane stride)
+            sup_rows = _packed_rows(kbj, fat_pack(w, presence))
+            if (
+                2 * J * sup_rows * 128 * 4 + 4 * (s * R8 * 128 * 4)
+                <= 9 * 1024 * 1024
+            ):
                 return J, R8, s, KJ, kbj
     return None
 
@@ -1003,7 +1037,7 @@ def _pack_planes(present_bf16: jnp.ndarray, w: int) -> jnp.ndarray:
 
 def _fat_kernel(
     starts_ref,  # SMEM [J * P8 + 1] i32 (scalar prefetch)
-    upd_ref,  # ANY [Btot, 128]: col 0 skey, 1..W masks, W+1 idx+1
+    upd_ref,  # ANY [BtotP, 128]: PACK updates/row at 128/PACK-lane stride
     blocks_ref,  # VMEM [S * R8, 128] fat rows (auto-streamed)
     *rest,  # out_ref [, pres_ref], sup_ref, sems
     R8: int,
@@ -1015,6 +1049,7 @@ def _fat_kernel(
     J: int,
     NBJ: int,
     PRES: bool,
+    PACK: int = 1,
 ):
     if PRES:
         out_ref, pres_ref, sup_ref, sems = rest
@@ -1023,14 +1058,17 @@ def _fat_kernel(
         pres_ref = None
     p = pl.program_id(0)
     num_p = pl.num_programs(0)
+    STRIDE = 128 // PACK
+    KJP = _packed_rows(KJ, PACK)  # window fetch rows (packed units)
+    KBJP = _packed_rows(KBJ, PACK)  # big fetch rows (packed units)
 
     def a_big(j, pp):
-        return (starts_ref[j * P8 + pp * S] // _ALIGN) * _ALIGN
+        return ((starts_ref[j * P8 + pp * S] // PACK) // _ALIGN) * _ALIGN
 
     def fetch(slot, pp):
         for j in range(J):
             pltpu.make_async_copy(
-                upd_ref.at[pl.ds(a_big(j, pp), KBJ), :],
+                upd_ref.at[pl.ds(a_big(j, pp), KBJP), :],
                 sup_ref.at[slot, j],
                 sems.at[slot, j],
             ).start()
@@ -1038,7 +1076,7 @@ def _fat_kernel(
     def wait(slot):
         for j in range(J):
             pltpu.make_async_copy(
-                upd_ref.at[pl.ds(0, KBJ), :],
+                upd_ref.at[pl.ds(0, KBJP), :],
                 sup_ref.at[slot, j],
                 sems.at[slot, j],
             ).wait()
@@ -1054,7 +1092,15 @@ def _fat_kernel(
         fetch(1 - slot, p + 1)
 
     wait(slot)
-    pres_acc = jnp.zeros((KJ, 128), jnp.uint32) if PRES else None
+    # presence slots live in a [KJP, 128] tile per grid step: slot u of
+    # packed row r in window (j, q=p*S+t) at row r, column
+    # (t*J + j)*PACK + u (requires S*J*PACK <= 128 — chooser-enforced).
+    pres_acc = jnp.zeros((KJP, 128), jnp.uint32) if PRES else None
+    colsR = lax.broadcasted_iota(jnp.int32, (KJP, R8), 1)
+    colp = (
+        lax.broadcasted_iota(jnp.int32, (KJP, 128), 1) if PRES else None
+    )
+    iota_r = lax.broadcasted_iota(jnp.int32, (KJP, 1), 0)
     for t in range(S):
         sl = pl.ds(t * R8, R8)
         tile = blocks_ref[sl, :]  # [R8, 128] pre-update fat rows
@@ -1063,62 +1109,72 @@ def _fat_kernel(
         for j in range(J):
             qi = j * P8 + p * S + t
             skey0 = _u32(j * NBJ) + _u32(base_rf)
-            colsR = lax.broadcasted_iota(jnp.int32, (KJ, R8), 1)
-
-            def win_parts(sub):
-                """(delta_words, oh_f32, bits, npos-free parts) of one
-                KJ-row update window against this sub-tile."""
-                rl = (sub[:, 0:1] - skey0).astype(jnp.int32)
-                oh_f32 = jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
-                bits = _expand_bits(sub[:, 1 : W + 1], KJ, W)
-                cnt = lax.dot_general(
+            rel = ((starts_ref[qi] // PACK) // _ALIGN) * _ALIGN - a_big(j, p)
+            rel = jnp.clip(rel, 0, KBJP - KJP)
+            sub0 = sup_ref[slot, j, pl.ds(rel, KJP), :]  # [KJP, 128]
+            a0 = a_big(j, p) + rel  # packed-row units
+            end = starts_ref[qi + 1]
+            if PRES:
+                tj = tile[:, j * W : (j + 1) * W]
+                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
+            # PACK update slots per fetched row, slot u at lanes
+            # [u*STRIDE, u*STRIDE + 1 + W (+1)). Each slot runs its own
+            # one-hot placement and the counts ADD (same total MACs as
+            # one big matmul; Mosaic cannot sublane-concat lane-sliced
+            # vectors — "offset mismatch on non-concat dimension").
+            # PACK=1 reduces to the original single-window pass.
+            cnt = None
+            for u in range(PACK):
+                base = u * STRIDE
+                rl = (sub0[:, base : base + 1] - skey0).astype(jnp.int32)
+                oh_f32 = jnp.where(
+                    rl == colsR, jnp.float32(1), jnp.float32(0)
+                )
+                bits = _expand_bits(sub0[:, base + 1 : base + 1 + W], KJP, W)
+                cnt_u = lax.dot_general(
                     oh_f32.astype(jnp.int8), bits.astype(jnp.int8),
                     (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32,
                 )  # [R8, W*32]
-                present = jnp.where(
-                    cnt > 0, jnp.float32(1), jnp.float32(0)
-                ).astype(jnp.bfloat16)
-                return _pack_planes(present, W), oh_f32, bits
-
-            rel = (starts_ref[qi] // _ALIGN) * _ALIGN - a_big(j, p)
-            rel = jnp.clip(rel, 0, KBJ - KJ)
-            sub0 = sup_ref[slot, j, pl.ds(rel, KJ), :]
-            delta_j, oh_f32, bits = win_parts(sub0)
+                cnt = cnt_u if cnt is None else cnt + cnt_u
+                if PRES:
+                    # G[s, r] = popcount(mask_s AND oldrow_r): one int8
+                    # matmul; slot s was present iff its own row's count
+                    # equals popcount(mask_s)
+                    G = lax.dot_general(
+                        bits.astype(jnp.int8), tilebits,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.int32,
+                    )  # [KJP, R8]
+                    hit = jnp.sum(
+                        G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
+                    )
+                    npos = jnp.sum(
+                        bits.astype(jnp.int32), axis=1, keepdims=True
+                    )
+                    idxp1 = sub0[:, base + W + 1 : base + W + 2]
+                    # global UPDATE index of (packed row r, slot u)
+                    ipos = (a0 + iota_r) * PACK + u
+                    real = (
+                        (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
+                    )
+                    hbit = jnp.where(
+                        hit == npos, _u32(0x80000000), _u32(0)
+                    )
+                    v = jnp.where(real, idxp1 | hbit, _u32(0))
+                    pres_acc = pres_acc | jnp.where(
+                        colp == (t * J + j) * PACK + u, v, _u32(0)
+                    )
             # NO in-kernel overflow chunks: a dynamic DMA loop in the body
             # defeats Mosaic's pipelining (measured +86% kernel time even
             # with zero iterations). Windows that overflow KJ (adversarial
             # duplicate skew only) are detected host-side from `starts`
             # and the WHOLE batch falls back to the sorted-scatter path
             # under lax.cond — see apply_fat_updates.
-            a0 = a_big(j, p) + rel
-            end = starts_ref[qi + 1]
-            deltas.append(delta_j)
-
-            if PRES:
-                # G[s, r] = popcount(mask_s AND oldrow_r): one int8
-                # matmul; slot s was present iff its own row's count
-                # equals popcount(mask_s)
-                tj = tile[:, j * W : (j + 1) * W]
-                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
-                G = lax.dot_general(
-                    bits.astype(jnp.int8), tilebits,
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.int32,
-                )  # [KJ, R8]
-                hit = jnp.sum(
-                    G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
-                )
-                npos = jnp.sum(bits.astype(jnp.int32), axis=1, keepdims=True)
-                idxp1 = sub0[:, W + 1 : W + 2]
-                ipos = lax.broadcasted_iota(jnp.int32, (KJ, 1), 0) + a0
-                real = (
-                    (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
-                )
-                hbit = jnp.where(hit == npos, _u32(0x80000000), _u32(0))
-                v = jnp.where(real, idxp1 | hbit, _u32(0))
-                colp = lax.broadcasted_iota(jnp.int32, (KJ, 128), 1)
-                pres_acc = pres_acc | jnp.where(colp == t * J + j, v, _u32(0))
+            present_pl = jnp.where(
+                cnt > 0, jnp.float32(1), jnp.float32(0)
+            ).astype(jnp.bfloat16)
+            deltas.append(_pack_planes(present_pl, W))
         delta_fat = jnp.concatenate(deltas, axis=1)  # [R8, J*W = 128]
         out_ref[sl, :] = tile | delta_fat
     if PRES:
@@ -1138,6 +1194,7 @@ def fat_sweep_insert(
     W: int,
     interpret: bool = False,
     with_presence: bool = False,
+    pack: int = 1,
 ):
     """Apply a substream-sorted update stream to the fat-row block view.
 
@@ -1153,14 +1210,16 @@ def fat_sweep_insert(
     assert L == 128
     P8 = NB8 // R8
     P = P8 // S
+    kjp = _packed_rows(KJ, pack)  # presence rows per grid step
+    kbjp = _packed_rows(KBJ, pack)  # big-fetch rows (packed units)
     out_shape = jax.ShapeDtypeStruct((NB8, 128), jnp.uint32)
     out_spec = pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0))
     if with_presence:
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((P * KJ, 128), jnp.uint32),
+            jax.ShapeDtypeStruct((P * kjp, 128), jnp.uint32),
         )
-        out_spec = (out_spec, pl.BlockSpec((KJ, 128), lambda p, *_: (p, 0)))
+        out_spec = (out_spec, pl.BlockSpec((kjp, 128), lambda p, *_: (p, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(P,),
@@ -1170,7 +1229,7 @@ def fat_sweep_insert(
         ],
         out_specs=out_spec,
         scratch_shapes=[
-            pltpu.VMEM((2, J, KBJ, 128), jnp.uint32),
+            pltpu.VMEM((2, J, kbjp, 128), jnp.uint32),
             pltpu.SemaphoreType.DMA((2, J)),
         ],
     )
@@ -1178,7 +1237,7 @@ def fat_sweep_insert(
         functools.partial(
             _fat_kernel,
             R8=R8, S=S, KJ=KJ, KBJ=KBJ, P8=P8, W=W, J=J, NBJ=NB8,
-            PRES=with_presence,
+            PRES=with_presence, PACK=pack,
         ),
         out_shape=out_shape,
         grid_spec=grid_spec,
@@ -1188,22 +1247,46 @@ def fat_sweep_insert(
     return fn(starts, upd, blocks_fat)
 
 
-def _fat_stream(skey_sorted, masks, idx_sorted, *, J, NBJ, P8, R8, KBJ, W):
+def fat_pack(w: int, presence: bool) -> int:
+    """Updates per 128-lane stream row. An update needs 1 (skey) + W
+    (masks/counts) + 1 (idx, presence only) lanes; when that fits a
+    32-lane stride, FOUR updates share each row — 4x fewer stream bytes
+    for both the host-side build write and the kernel's window fetches.
+    (Sub-128-lane arrays cannot shrink the stream instead: Mosaic pads
+    their HBM layout to 128 lanes and then rejects manual-DMA slices —
+    measured, benchmarks/lane_probe.py.)"""
+    return 4 if 1 + w + (1 if presence else 0) <= 32 else 1
+
+
+def _packed_rows(n_upd: int, pack: int) -> int:
+    """Fetch/window length in PACKED rows covering ``n_upd`` updates plus
+    the 8-aligned fetch floor (<= 7 rows) and the end-row straddle
+    (1 row), rounded to a multiple of 8. pack=1 keeps the legacy
+    unpacked geometry bit-for-bit."""
+    if pack == 1:
+        return n_upd
+    return ((n_upd // pack + _ALIGN) + 7) // 8 * 8
+
+
+def _fat_stream(
+    skey_sorted, masks, idx_sorted, *, J, NBJ, P8, R8, KBJ, W, pack=1
+):
     """Single-pass update-stream assembly for the fat sweep: one
     concatenate builds the [Btot, 128] buffer (multiple .at[].set()
-    passes measurably cost ~2 GB of extra HBM writes each at B=4M)."""
+    passes measurably cost ~2 GB of extra HBM writes each at B=4M).
+
+    With ``pack`` > 1, consecutive sorted updates share each 128-lane
+    row at a ``128 // pack``-lane stride (update u of packed row r is
+    update ``r * pack + u`` of the sorted stream — a plain row-major
+    fold, so one XLA reshape builds it). ``starts`` stays in UPDATE
+    units; the kernel converts to packed rows."""
     B = masks.shape[0]
-    pad = KBJ + _ALIGN
     cols = [skey_sorted.astype(jnp.uint32)[:, None], masks]
     ncols = 1 + W
     if idx_sorted is not None:
         cols.append(idx_sorted.astype(jnp.uint32)[:, None])
         ncols += 1
     core = jnp.concatenate(cols, axis=1)
-    # jnp.pad lowers to one fused write here; concatenating explicit
-    # zero blocks measurably costs ~2x (2 GB array at B=4M)
-    upd = jnp.pad(core, ((0, pad), (0, 128 - ncols)))
-    upd = upd.at[B:, 0].set(jnp.uint32(J * NBJ))
     jq = jnp.arange(J * P8 + 1, dtype=jnp.int32)
     tgt = jnp.where(
         jq == J * P8, J * NBJ, (jq // P8) * NBJ + (jq % P8) * R8
@@ -1211,36 +1294,63 @@ def _fat_stream(skey_sorted, masks, idx_sorted, *, J, NBJ, P8, R8, KBJ, W):
     starts = jnp.searchsorted(skey_sorted.astype(jnp.int32), tgt).astype(
         jnp.int32
     )
-    return upd, starts
+    if pack == 1:
+        pad = KBJ + _ALIGN
+        # jnp.pad lowers to one fused write here; concatenating explicit
+        # zero blocks measurably costs ~2x (2 GB array at B=4M)
+        upd = jnp.pad(core, ((0, pad), (0, 128 - ncols)))
+        upd = upd.at[B:, 0].set(jnp.uint32(J * NBJ))
+        return upd, starts
+    stride = 128 // pack
+    kbjp = _packed_rows(KBJ, pack)
+    btot_p = -(-B // pack) + kbjp + _ALIGN
+    padrows = btot_p * pack - B
+    wide = jnp.pad(core, ((0, padrows), (0, stride - ncols)))
+    wide = wide.at[B:, 0].set(jnp.uint32(J * NBJ))
+    return wide.reshape(btot_p, 128), starts
 
 
-def _fat_window_overflow(starts, *, J, P8, S, KJ, KBJ):
+def _fat_window_overflow(starts, *, J, P8, S, KJ, KBJ, pack=1):
     """True if any (j, q) window cannot cover its slice from the clamped
     KJ-row fetch. The fat kernel has NO chunk loop (rows beyond the KJ
     window are silently never applied), so on overflow apply_fat_updates
     routes the WHOLE batch — insert AND presence — to the sorted-scatter
     branch under lax.cond; that branch is the only thing keeping
-    overflowing batches correct."""
+    overflowing batches correct. The packed arithmetic mirrors the
+    kernel's exactly (same floor/clip in packed-row units)."""
     s = starts
     jq = jnp.arange(J * P8, dtype=jnp.int32)
     big_idx = (jq // P8) * P8 + ((jq % P8) // S) * S
-    a_big = (s[big_idx] // _ALIGN) * _ALIGN
-    a = a_big + jnp.clip((s[jq] // _ALIGN) * _ALIGN - a_big, 0, KBJ - KJ)
-    return jnp.max(s[jq + 1] - a) > KJ
+    if pack == 1:
+        a_big = (s[big_idx] // _ALIGN) * _ALIGN
+        a = a_big + jnp.clip((s[jq] // _ALIGN) * _ALIGN - a_big, 0, KBJ - KJ)
+        return jnp.max(s[jq + 1] - a) > KJ
+    kjp = _packed_rows(KJ, pack)
+    kbjp = _packed_rows(KBJ, pack)
+    a_big = ((s[big_idx] // pack) // _ALIGN) * _ALIGN
+    r4 = ((s[jq] // pack) // _ALIGN) * _ALIGN
+    a = a_big + jnp.clip(r4 - a_big, 0, kbjp - kjp)
+    need_end = -(-(s[jq + 1]) // pack)  # ceil in packed rows
+    return jnp.max(need_end - a) > kjp
 
 
-def _fat_unsort_presence(presb, starts, B, *, J, NBJ, P8, R8, S, KJ, KBJ):
+def _fat_unsort_presence(
+    presb, starts, B, *, J, NBJ, P8, R8, S, KJ, KBJ, pack=1
+):
     """Presence tiles -> bool[B] in original key order via the vkey
     single-column unsort (idx+1 rides bits 1.., verdict the LSB; empty
-    slots sink to the tail)."""
+    slots sink to the tail). ``KJ`` here is the PACKED rows per window
+    (KJP); slot u of window (j, q) rides column (t*J + j)*pack + u."""
     P = P8 // S
-    jq = jnp.arange(J * P8, dtype=jnp.int32)
+    jqu = jnp.arange(J * P8 * pack, dtype=jnp.int32)
+    jq = jqu // pack
+    u = jqu % pack
     j = jq // P8
     q = jq % P8
     p0 = q // S
     t = q % S
     presT = presb.reshape(P, KJ, 128).transpose(0, 2, 1).reshape(P * 128, KJ)
-    v = presT[p0 * 128 + t * J + j]  # [J*P8, KJ]
+    v = presT[p0 * 128 + (t * J + j) * pack + u]  # [J*P8*pack, KJ]
     vkey = jnp.where(
         v == 0,
         _u32(0xFFFFFFFE),  # even: empty slots must read as hit=0
@@ -1310,10 +1420,14 @@ def apply_fat_updates(
     )
     masks = blocked.build_masks(bit_sorted, w)
     idx_sorted = sorted_cols[-1] if idx is not None else None
+    pack = fat_pack(w, idx is not None)
     upd, starts = _fat_stream(
-        ss, masks, idx_sorted, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=w
+        ss, masks, idx_sorted, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=w,
+        pack=pack,
     )
-    overflow = _fat_window_overflow(starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ)
+    overflow = _fat_window_overflow(
+        starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ, pack=pack
+    )
 
     def to_fat(bl):
         return bl if storage_fat else bl.reshape(NBJ, 128)
@@ -1321,8 +1435,14 @@ def apply_fat_updates(
     def from_fat(bl_fat):
         return bl_fat if storage_fat else bl_fat.reshape(nb, w)
 
-    def to_logical(bl):
-        return bl.reshape(nb, w) if storage_fat else bl
+    def _scatter_coords():
+        """(row, masks) for the fallback in whichever view ``blocks``
+        is stored — the fat fold keeps the fallback reshape-free
+        (a fat <-> [NB, W] reshape is a real copy on TPU)."""
+        masks_orig = blocked.build_masks(bit, w)
+        if storage_fat:
+            return blocked.fat_fold_masks(blk, masks_orig, J)
+        return blk, masks_orig
 
     if idx is None:
 
@@ -1332,14 +1452,14 @@ def apply_fat_updates(
                 fat_sweep_insert(
                     to_fat(bl), u, st,
                     J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, interpret=interp,
+                    pack=pack,
                 )
             )
 
         def scatter_branch(ops):
             bl, u, st = ops
-            masks_orig = blocked.build_masks(bit, w)
-            out = blocked.blocked_insert(to_logical(bl), blk, masks_orig, valid)
-            return out.reshape(blocks.shape)
+            row, masks_orig = _scatter_coords()
+            return blocked.blocked_insert(bl, row, masks_orig, valid)
 
         return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
 
@@ -1348,22 +1468,298 @@ def apply_fat_updates(
         new_fat, presb = fat_sweep_insert(
             to_fat(bl), u, st,
             J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
-            interpret=interp, with_presence=True,
+            interpret=interp, with_presence=True, pack=pack,
         )
         present = _fat_unsort_presence(
-            presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S, KJ=KJ, KBJ=KBJ
+            presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
+            KJ=_packed_rows(KJ, pack), KBJ=KBJ, pack=pack,
         )
         return from_fat(new_fat), present
 
     def scatter_branch(ops):
         bl, u, st = ops
-        bll = to_logical(bl)
-        masks_orig = blocked.build_masks(bit, w)
-        rows = bll[jnp.minimum(blkv, nb - 1)]
+        row, masks_orig = _scatter_coords()
+        nrows = bl.shape[0]
+        rows = bl[jnp.minimum(jnp.where(valid, row, 0), nrows - 1)]
         hit = jnp.all((rows & masks_orig) == masks_orig, axis=-1)
         present = hit & valid
-        out = blocked.blocked_insert(bll, blk, masks_orig, valid)
-        return out.reshape(blocks.shape), present
+        out = blocked.blocked_insert(bl, row, masks_orig, valid)
+        return out, present
+
+    return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
+
+
+def _fat_count_kernel(
+    starts_ref,  # SMEM [J * P8 + 1] i32 (scalar prefetch)
+    upd_ref,  # ANY [Btot, 128]: col 0 skey, 1..W packed nibble counts
+    blocks_ref,  # VMEM [S * R8, 128] fat counter rows (auto-streamed)
+    out_ref,  # VMEM [S * R8, 128]
+    sup_ref,  # VMEM scratch [2, J, KBJ, 128] u32
+    sems,  # DMA sems [2, J]
+    *,
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    P8: int,
+    W: int,
+    J: int,
+    NBJ: int,
+    INCREMENT: bool,
+    PACK: int = 1,
+):
+    """Fat-row blocked-counting sweep: saturating nibble add/subtract on
+    the [NB/J, 128] counter view (same substream-sorted stream layout as
+    :func:`_fat_kernel`, including the PACK-updates-per-row stream; same
+    one-clamp-per-batch semantics as :func:`_count_kernel` — counts are
+    additive so there is no merge or presence machinery, and like the fat
+    bit kernel there is NO in-kernel chunk loop: window overflow routes
+    the batch to the scatter fallback host-side)."""
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+    STRIDE = 128 // PACK
+    KJP = _packed_rows(KJ, PACK)
+    KBJP = _packed_rows(KBJ, PACK)
+
+    def a_big(j, pp):
+        return ((starts_ref[j * P8 + pp * S] // PACK) // _ALIGN) * _ALIGN
+
+    def fetch(slot, pp):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(a_big(j, pp), KBJP), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).start()
+
+    def wait(slot):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(0, KBJP), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, 0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, p + 1)
+
+    wait(slot)
+    CPB = W * 8  # nibble planes per block
+    colC = lax.broadcasted_iota(jnp.int32, (KJP, CPB), 1)
+    colsR = lax.broadcasted_iota(jnp.int32, (KJP, R8), 1)
+    tcolC = lax.broadcasted_iota(jnp.int32, (R8, CPB), 1)
+    # block-diagonal plane->word pack weights, one [J*CPB, 128] matrix
+    # per byte q: plane (j, n*W + w) contributes 1 (n even) or 16 (n
+    # odd) to lane j*W + w when n // 2 == q (same exact-byte matmul
+    # trick as _count_kernel, widened to the full fat row so each
+    # sub-tile packs with 4 matmuls instead of 4*J narrow ones)
+    pcJ = lax.broadcasted_iota(jnp.int32, (J * CPB, 128), 0)
+    lnJ = lax.broadcasted_iota(jnp.int32, (J * CPB, 128), 1)
+    j_of = pcJ // CPB
+    n_of = lax.rem(pcJ, CPB) // W
+    w_of = lax.rem(pcJ, W)
+    lane_match = lnJ == j_of * W + w_of
+    pack_qs = []
+    for q in range(4):
+        pack_qs.append(
+            jnp.where(
+                lane_match & (n_of // 2 == q),
+                jnp.where(lax.rem(n_of, 2) == 0, jnp.float32(1), jnp.float32(16)),
+                jnp.float32(0),
+            ).astype(jnp.bfloat16)
+        )
+    for t in range(S):
+        sl = pl.ds(t * R8, R8)
+        tile = blocks_ref[sl, :]  # [R8, 128] pre-update fat counter rows
+        base_rf = (p * S + t) * R8
+        news = []
+        for j in range(J):
+            qi = j * P8 + p * S + t
+            skey0 = _u32(j * NBJ) + _u32(base_rf)
+            rel = ((starts_ref[qi] // PACK) // _ALIGN) * _ALIGN - a_big(j, p)
+            rel = jnp.clip(rel, 0, KBJP - KJP)
+            sub = sup_ref[slot, j, pl.ds(rel, KJP), :]  # [KJP, 128]
+            # per-slot accumulation (Mosaic cannot sublane-concat
+            # lane-sliced vectors); counts ADD across slots, same total
+            # MACs. PACK=1 reduces to the original single pass.
+            cnts = None
+            for u in range(PACK):
+                base = u * STRIDE
+                rl = (sub[:, base : base + 1] - skey0).astype(jnp.int32)
+                oh = jnp.where(
+                    rl == colsR, jnp.float32(1), jnp.float32(0)
+                ).astype(jnp.bfloat16)  # [KJP, R8]; sentinels match nothing
+                m = sub[:, base + 1 : base + 1 + W]  # [KJP, W] nibbles
+                rep = jnp.concatenate([m] * 8, axis=1)  # [KJP, CPB]
+                nib = (
+                    rep >> ((colC // W).astype(jnp.uint32) * _u32(4))
+                ) & _u32(15)
+                nibf = (
+                    nib.astype(jnp.int32)
+                    .astype(jnp.float32)
+                    .astype(jnp.bfloat16)
+                )
+                cnt_u = lax.dot_general(
+                    oh, nibf, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [R8, CPB], exact (<= 15 * KJP * PACK < 2^24)
+                cnts = cnt_u if cnts is None else cnts + cnt_u
+            acc = jnp.minimum(cnts, jnp.float32(16))
+            tj = tile[:, j * W : (j + 1) * W]
+            trep = jnp.concatenate([tj] * 8, axis=1)  # [R8, CPB]
+            old = (trep >> ((tcolC // W).astype(jnp.uint32) * _u32(4))) & _u32(15)
+            oldf = old.astype(jnp.int32).astype(jnp.float32)
+            if INCREMENT:
+                new = jnp.minimum(oldf + acc, jnp.float32(15))
+            else:
+                new = jnp.maximum(oldf - acc, jnp.float32(0))
+            news.append(new.astype(jnp.bfloat16))  # <= 15, bf16-exact
+        new_all = jnp.concatenate(news, axis=1)  # [R8, J*CPB]
+        packed = jnp.zeros((R8, 128), jnp.uint32)
+        for q in range(4):
+            byte = lax.dot_general(
+                new_all, pack_qs[q], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R8, 128] f32-exact bytes
+            packed = packed | (
+                byte.astype(jnp.int32).astype(jnp.uint32) << _u32(8 * q)
+            )
+        out_ref[sl, :] = packed
+
+
+def fat_sweep_counter(
+    blocks_fat: jnp.ndarray,
+    upd: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    J: int,
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    W: int,
+    increment: bool,
+    interpret: bool = False,
+    pack: int = 1,
+) -> jnp.ndarray:
+    """Apply a substream-sorted nibble-count stream to the fat counter
+    view. Same stream contract as :func:`fat_sweep_insert` with cols
+    1..W carrying packed 4-bit per-counter multiplicities instead of OR
+    masks."""
+    NB8, L = blocks_fat.shape
+    assert L == 128
+    P8 = NB8 // R8
+    P = P8 // S
+    kbjp = _packed_rows(KBJ, pack)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, J, kbjp, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, J)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _fat_count_kernel,
+            R8=R8, S=S, KJ=KJ, KBJ=KBJ, P8=P8, W=W, J=J, NBJ=NB8,
+            INCREMENT=increment, PACK=pack,
+        ),
+        out_shape=jax.ShapeDtypeStruct((NB8, 128), jnp.uint32),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )
+    return fn(starts, upd, blocks_fat)
+
+
+def apply_fat_counter_updates(
+    blocks: jnp.ndarray,
+    blk: jnp.ndarray,
+    cpos: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    counters_per_block: int,
+    k: int,
+    increment: bool,
+    params,
+    interpret: bool | None = None,
+    storage_fat: bool = False,
+) -> jnp.ndarray:
+    """Fat-sweep counterpart of :func:`apply_counter_updates`; ``params``
+    from :func:`choose_fat_params` (presence=False — counting has no
+    fused-presence variant). Window overflow (adversarial duplicate
+    skew) routes the WHOLE batch to the flat scatter fallback under
+    ``lax.cond``, exactly like :func:`apply_fat_updates`.
+
+    ``storage_fat``: ``blocks`` is already the fat [NB/J, 128] view and
+    the fat view is returned."""
+    from tpubloom.ops import counting
+
+    J0, R8, S, KJ, KBJ = params
+    cpb = counters_per_block
+    w = cpb // 8
+    nb = blocks.size // w
+    B = blk.shape[0]
+    J = J0
+    NBJ = nb // J
+    P8 = NBJ // R8
+    interp = jax.default_backend() == "cpu" if interpret is None else interpret
+    blkv = jnp.where(valid, blk, nb)
+    j_of = (blkv % J).astype(jnp.uint32)
+    rf_of = (blkv // J).astype(jnp.uint32)
+    skey = jnp.where(valid, j_of * NBJ + rf_of, _u32(J * NBJ))
+    cols, nbits, packed = _pack_positions(cpos, cpb, k)
+    sorted_cols = lax.sort((skey,) + cols, num_keys=1)
+    ss = sorted_cols[0]
+    cpos_s = _unpack_positions(sorted_cols[1:], cpb, k, nbits, packed)
+    # per-key multiplicity of each counter, 4-bit nibbles in the counter
+    # storage (word, nibble) layout (multiplicity <= k <= 15)
+    planes = jnp.zeros((B, cpb), jnp.uint32)
+    iota_c = lax.broadcasted_iota(jnp.uint32, (B, cpb), 1)
+    for i in range(k):
+        planes = planes + (cpos_s[:, i : i + 1] == iota_c).astype(jnp.uint32)
+    pw = planes.reshape(B, w, 8)
+    shifts = (jnp.arange(8, dtype=jnp.uint32) * 4)[None, None, :]
+    cnt_words = jnp.sum(pw << shifts, axis=2, dtype=jnp.uint32)  # [B, W]
+    pack = fat_pack(w, False)
+    upd, starts = _fat_stream(
+        ss, cnt_words, None, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=w,
+        pack=pack,
+    )
+    overflow = _fat_window_overflow(
+        starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ, pack=pack
+    )
+
+    def fat_branch(ops):
+        bl, u, st = ops
+        out = fat_sweep_counter(
+            bl if storage_fat else bl.reshape(NBJ, 128), u, st,
+            J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
+            increment=increment, interpret=interp, pack=pack,
+        )
+        return out if storage_fat else out.reshape(nb, w)
+
+    def scatter_branch(ops):
+        bl, u, st = ops
+        gpos = (blk[:, None] * cpb + cpos.astype(jnp.int32)).astype(jnp.int32)
+        valid_k = jnp.broadcast_to(valid[:, None], gpos.shape)
+        out = counting.counter_update(
+            bl.reshape(-1), gpos.ravel(), valid_k.ravel(), increment=increment
+        )
+        return out.reshape(blocks.shape)
 
     return lax.cond(overflow, scatter_branch, fat_branch, (blocks, upd, starts))
 

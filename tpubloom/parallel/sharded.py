@@ -168,6 +168,33 @@ def make_sharded_query_fn(config: FilterConfig, mesh: Mesh):
     )
 
 
+def local_blocked_storage_fat(config: FilterConfig) -> bool:
+    """Whether the sharded blocked storage keeps each shard's rows in the
+    fat [NBL*W/128, 128] view (mirrors filter.blocked_storage_fat on the
+    PER-SHARD geometry — mesh-size independent, because fat rows never
+    straddle a shard boundary when NBL % J == 0). Applies to plain and
+    counting blocked layouts; the per-device hot loop then runs the
+    fat-row kernels at the 128-lane DMA tier (VERDICT r3 #3)."""
+    if not config.block_bits:
+        return False
+    w = config.words_per_block
+    return 128 % w == 0 and config.n_blocks_per_shard % (128 // w) == 0
+
+
+def sharded_blocked_shape(config: FilterConfig) -> tuple[int, int, int]:
+    """Global device-array shape for sharded blocked storage (plain or
+    counting): per-shard fat rows when :func:`local_blocked_storage_fat`
+    holds, else logical rows. The ONE place the sharded fat geometry is
+    spelled out (ShardedBloomFilter and the driver dryrun both use it)."""
+    if local_blocked_storage_fat(config):
+        return (
+            config.shards,
+            config.n_blocks_per_shard * config.words_per_block // 128,
+            128,
+        )
+    return (config.shards, config.n_blocks_per_shard, config.words_per_block)
+
+
 def _routed_blocks(
     config: FilterConfig, shards_per_dev: int, keys_u8, lengths, *, want_bit=False
 ):
@@ -199,15 +226,51 @@ def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
     shards_per_dev = config.shards // mesh.devices.size
     local_rows = shards_per_dev * config.n_blocks_per_shard
 
+    fat_store = local_blocked_storage_fat(config)
+    n_dev = mesh.devices.size
+    w = config.words_per_block
+
     def local_insert(blocks_block, keys_u8, lengths):
         from tpubloom.ops import sweep
 
-        # blocks_block: [shards_per_dev, n_blocks_local, W] — local rows.
+        # blocks_block: [shards_per_dev, n_blocks_local, W] logical or
+        # [shards_per_dev, NBL*W/128, 128] fat — this device's rows.
+        B = keys_u8.shape[0]
         blk, masks, owned, bit = _routed_blocks(
             config, shards_per_dev, keys_u8, lengths, want_bit=True
         )
-        flat = blocks_block.reshape(-1, config.words_per_block)
-        if _use_local_sweep(config, local_rows, keys_u8.shape[0]):
+        use_sweep = _use_local_sweep(config, local_rows, B)
+        if fat_store:
+            flat = blocks_block.reshape(-1, 128)  # [spd*NBLJ, 128]
+            # window sizing uses the EXPECTED owned count (~B/n_dev):
+            # sizing for the full replicated batch would inflate KJ by
+            # n_dev x; per-window occupancy of owned keys is Poisson, so
+            # lam+8sigma of B/n_dev covers it (overflow -> scatter
+            # fallback inside apply_fat_updates keeps skew correct)
+            fat_params = (
+                sweep.choose_fat_params(local_rows, max(1, B // n_dev), w)
+                if use_sweep
+                else None
+            )
+            if fat_params is not None:
+                out = sweep.apply_fat_updates(
+                    flat, blk, bit, owned, block_bits=config.block_bits,
+                    params=fat_params, storage_fat=True,
+                )
+                return out.reshape(blocks_block.shape)
+            if use_sweep:
+                # legacy kernel needs the logical view (reshape copy —
+                # only shapes the fat chooser rejects land here)
+                out = sweep.apply_blocked_updates(
+                    flat.reshape(-1, w), blk, bit, owned,
+                    block_bits=config.block_bits,
+                )
+                return out.reshape(blocks_block.shape)
+            frow, m128 = blocked.fat_fold_masks(blk, masks, 128 // w)
+            out = blocked.blocked_insert(flat, frow, m128, owned)
+            return out.reshape(blocks_block.shape)
+        flat = blocks_block.reshape(-1, w)
+        if use_sweep:
             flat = sweep.apply_blocked_updates(
                 flat, blk, bit, owned, block_bits=config.block_bits
             )
@@ -231,9 +294,16 @@ def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
     the flat path: owners answer, ICI all-reduce merges."""
     shards_per_dev = config.shards // mesh.devices.size
 
+    fat_store = local_blocked_storage_fat(config)
+    w = config.words_per_block
+
     def local_query(blocks_block, keys_u8, lengths):
         blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
-        flat = blocks_block.reshape(-1, config.words_per_block)
+        if fat_store:
+            flat = blocks_block.reshape(-1, 128)
+            blk, masks = blocked.fat_fold_masks(blk, masks, 128 // w)
+        else:
+            flat = blocks_block.reshape(-1, w)
         verdict = blocked.blocked_query(flat, blk, masks)
         one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
         hit = jax.lax.psum(one_hot, AXIS)
@@ -340,14 +410,18 @@ def make_sharded_blocked_counter_fn(
     local_rows = shards_per_dev * config.n_blocks_per_shard
     cpb = config.counters_per_block
 
+    fat_store = local_blocked_storage_fat(config)
+    n_dev = mesh.devices.size
+    w = config.words_per_block
+
     def local_update(blocks_block, keys_u8, lengths):
         from tpubloom.ops import sweep
 
+        B = keys_u8.shape[0]
         blk, cpos, owned = _routed_counter_blocks(
             config, shards_per_dev, keys_u8, lengths
         )
-        flat = blocks_block.reshape(-1, config.words_per_block)
-        use_sweep = _use_local_sweep(config, local_rows, keys_u8.shape[0])
+        use_sweep = _use_local_sweep(config, local_rows, B)
         if use_sweep and config.k > 15:
             if config.insert_path == "sweep":
                 # match the single-chip contract (filter.py): a forced
@@ -357,6 +431,38 @@ def make_sharded_blocked_counter_fn(
                     "insert_path='scatter'"
                 )
             use_sweep = False
+        if fat_store:
+            flat = blocks_block.reshape(-1, 128)
+            fat_params = (
+                sweep.choose_fat_params(local_rows, max(1, B // n_dev), w)
+                if use_sweep
+                else None
+            )
+            if fat_params is not None:
+                out = sweep.apply_fat_counter_updates(
+                    flat, blk, cpos, owned,
+                    counters_per_block=cpb, k=config.k, increment=increment,
+                    params=fat_params, storage_fat=True,
+                )
+                return out.reshape(blocks_block.shape)
+            if use_sweep:
+                out = sweep.apply_counter_updates(
+                    flat.reshape(-1, w), blk, cpos, owned,
+                    counters_per_block=cpb, k=config.k, increment=increment,
+                )
+                return out.reshape(blocks_block.shape)
+            # flat scatter fallback: the raveled fat bytes ARE the
+            # raveled logical bytes — no fold or reshape copy needed
+            gpos = (blk[:, None] * cpb + cpos.astype(jnp.int32)).astype(
+                jnp.int32
+            )
+            valid_k = jnp.broadcast_to(owned[:, None], gpos.shape)
+            out = counting.counter_update(
+                flat.reshape(-1), gpos.ravel(), valid_k.ravel(),
+                increment=increment,
+            )
+            return out.reshape(blocks_block.shape)
+        flat = blocks_block.reshape(-1, w)
         if use_sweep:
             flat = sweep.apply_counter_updates(
                 flat, blk, cpos, owned,
@@ -387,12 +493,21 @@ def make_sharded_blocked_counting_query_fn(config: FilterConfig, mesh: Mesh):
     shards_per_dev = config.shards // mesh.devices.size
     cpb = config.counters_per_block
 
+    fat_store = local_blocked_storage_fat(config)
+    w = config.words_per_block
+
     def local_query(blocks_block, keys_u8, lengths):
         blk, cpos, owned = _routed_counter_blocks(
             config, shards_per_dev, keys_u8, lengths
         )
-        flat = blocks_block.reshape(-1, config.words_per_block)
-        verdict = counting.blocked_counting_membership(flat, blk, cpos)
+        if fat_store:
+            flat = blocks_block.reshape(-1, 128)
+            verdict = counting.fat_blocked_counting_membership(
+                flat, blk, cpos, w
+            )
+        else:
+            flat = blocks_block.reshape(-1, w)
+            verdict = counting.blocked_counting_membership(flat, blk, cpos)
         one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
         hit = jax.lax.psum(one_hot, AXIS)
         return hit > 0
@@ -426,17 +541,14 @@ class ShardedBloomFilter(_FilterBase):
                 f"{self.mesh.devices.size}"
             )
         super().__init__(config, 0)  # words set below with explicit sharding
+        # per-shard fat [NBL*W/128, 128] storage where the shard geometry
+        # allows (same row-major bytes per shard; 128-lane DMA tier for
+        # the per-device hot loop — see filter.BlockedBloomFilter)
+        self._fat = local_blocked_storage_fat(config)
         if config.counting and config.block_bits:
             self.sharding = NamedSharding(self.mesh, P(AXIS, None, None))
             self.words = jax.device_put(
-                jnp.zeros(
-                    (
-                        config.shards,
-                        config.n_blocks_per_shard,
-                        config.words_per_block,
-                    ),
-                    jnp.uint32,
-                ),
+                jnp.zeros(sharded_blocked_shape(config), jnp.uint32),
                 self.sharding,
             )
             self._insert = jax.jit(
@@ -473,14 +585,7 @@ class ShardedBloomFilter(_FilterBase):
         elif config.block_bits:
             self.sharding = NamedSharding(self.mesh, P(AXIS, None, None))
             self.words = jax.device_put(
-                jnp.zeros(
-                    (
-                        config.shards,
-                        config.n_blocks_per_shard,
-                        config.words_per_block,
-                    ),
-                    jnp.uint32,
-                ),
+                jnp.zeros(sharded_blocked_shape(config), jnp.uint32),
                 self.sharding,
             )
             self._insert = jax.jit(
@@ -531,6 +636,20 @@ class ShardedBloomFilter(_FilterBase):
                 }
             ),
         }
+
+    @property
+    def words_logical(self) -> np.ndarray:
+        """Host copy in the logical per-shard layout: [shards, NBL, W]
+        for blocked configs (undoing the fat per-shard view — same
+        row-major bytes), else the device shape."""
+        host = np.asarray(self.words)
+        if self.config.block_bits:
+            return host.reshape(
+                self.config.shards,
+                self.config.n_blocks_per_shard,
+                self.config.words_per_block,
+            )
+        return host
 
     # Persistence: global layout = shard-major concatenation; bit
     # (s * m_local + p) of the export is bit p of shard s. Round-trips

@@ -99,12 +99,16 @@ def _run_bench(platform: str) -> dict:
     # membership per key (the insert+query pair of the metric; the
     # reference's Lua add script has the same fused semantics).
     blk_config = FilterConfig(m=1 << log2m, k=7, key_len=key_len, block_bits=512)
-    blk_insert = make_blocked_insert_fn(blk_config)
-    blk_query = make_blocked_query_fn(blk_config)
-    blk_ti = make_blocked_test_insert_fn(blk_config)
-    blk_state0 = jnp.zeros(
-        (blk_config.n_blocks, blk_config.words_per_block), jnp.uint32
-    )
+    # fat [NB/J, 128] storage — the layout persistent filters actually
+    # hold; the logical [NB, W] entry pays a real reshape copy per pass
+    # (~26 ms at m=2^32, benchmarks/RESULTS_r3.md §2)
+    from tpubloom.filter import blocked_device_shape, blocked_storage_fat
+
+    blk_fat = blocked_storage_fat(blk_config)
+    blk_insert = make_blocked_insert_fn(blk_config, storage_fat=blk_fat)
+    blk_query = make_blocked_query_fn(blk_config, storage_fat=blk_fat)
+    blk_ti = make_blocked_test_insert_fn(blk_config, storage_fat=blk_fat)
+    blk_state0 = jnp.zeros(blocked_device_shape(blk_config), jnp.uint32)
 
     def fused_step(state, seed):
         keys = jax.random.bits(jax.random.key(seed), (B, key_len), jnp.uint8)

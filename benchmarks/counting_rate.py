@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Blocked-counting insert/delete rate on the fat packed kernel
+(VERDICT r3 #4 "done =" clause: counting insert/delete rate, measured
+against the 26.1M ops/s round-1 narrow-tile figure).
+
+m=2^30 counters (BASELINE config 4), k=7, blocked512, fat storage,
+B=4M device-generated keys, to-value timing, alternating insert/delete
+steps so the counter array stays bounded. Writes
+benchmarks/out/counting_rate_r4.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import blocked_device_shape, make_blocked_counter_fn
+
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 16
+OUT_PATH = os.path.join(
+    os.path.dirname(__file__), "out", "counting_rate_r4.json"
+)
+
+
+def main():
+    config = FilterConfig(
+        m=1 << 30, k=7, key_len=KEY_LEN, counting=True, block_bits=512
+    )
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+    ins = make_blocked_counter_fn(config, increment=True, storage_fat=True)
+    dele = make_blocked_counter_fn(config, increment=False, storage_fat=True)
+
+    def step(state, carry, i):
+        # seed depends ONLY on i // 2 so step 2n+1 deletes exactly the
+        # keys step 2n inserted (counters return to 0; no saturation
+        # drift). carry is the to-value fence, not a seed input — mixing
+        # it in would desynchronize the insert/delete key pairs.
+        keys = jax.random.bits(jax.random.key(i // 2), (B, KEY_LEN), jnp.uint8)
+        # even steps insert, odd steps delete the same keys — counters
+        # return to ~0, so saturation never bounds the run
+        state = jax.lax.cond(
+            i % 2 == 0,
+            lambda s: ins(s, keys, lengths),
+            lambda s: dele(s, keys, lengths),
+            state,
+        )
+        return state, carry ^ jnp.sum(state[0], dtype=jnp.uint32)
+
+    jit = jax.jit(step, donate_argnums=(0,))
+    state = jnp.zeros(blocked_device_shape(config), jnp.uint32)
+    t0 = time.perf_counter()
+    state, carry = jit(state, jnp.uint32(0), 0)
+    int(np.asarray(carry))
+    compile_s = time.perf_counter() - t0
+    state, carry = jit(state, carry, 1)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(2, 2 + STEPS):
+        state, carry = jit(state, carry, i)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    row = {
+        "metric": "blocked counting insert/delete ops/sec (fat packed kernel)",
+        "m_counters": config.m,
+        "k": config.k,
+        "B": B,
+        "ms_per_step": round(dt * 1e3, 2),
+        "ops_per_sec": round(B / dt),
+        "vs_round1_narrow_tile": round(B / dt / 26.1e6, 2),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.default_backend(),
+        "timing": "to-value, 16 chained alternating insert/delete steps",
+    }
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -257,7 +257,7 @@ def unsort_only():
     if not PRESENCE:
         return
     P = P8 // S
-    presb = jax.random.bits(jax.random.key(3), (P * KJP, 128), jnp.uint32)
+    presb = jax.random.bits(jax.random.key(3), (P * PACK * KJP, 128), jnp.uint32)
     keys = jax.device_put(
         np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
     )
@@ -266,7 +266,7 @@ def unsort_only():
     def step(presb, carry):
         pres = _fat_unsort_presence(
             presb ^ carry, starts, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
-            KJ=KJP, KBJ=KBJ, pack=PACK,
+            KJ=PACK * KJP, KBJ=KBJ,
         )
         return jnp.sum(pres.astype(jnp.uint32))
 

@@ -298,11 +298,7 @@ def make_blocked_query_fn(config: FilterConfig, *, storage_fat: bool = False):
         masks = blocked.build_masks(bit, w)
         if not storage_fat:
             return blocked.blocked_query(blocks, blk, masks)
-        rows128 = blocks[(blk // J).astype(jnp.int32)]  # [B, 128]
-        lane0 = ((blk % J) * w).astype(jnp.int32)[:, None]
-        cols = lane0 + jnp.arange(w, dtype=jnp.int32)[None, :]
-        rows = jnp.take_along_axis(rows128, cols, axis=1)  # [B, W]
-        return jnp.all((rows & masks) == masks, axis=-1)
+        return blocked.fat_blocked_query(blocks, blk, masks)
 
     return query
 

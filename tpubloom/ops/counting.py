@@ -116,15 +116,25 @@ def fat_blocked_counting_membership(
     blocks_fat: jnp.ndarray, blk: jnp.ndarray, cpos: jnp.ndarray, w: int
 ) -> jnp.ndarray:
     """Blocked-counting membership against the FAT [NB/J, 128] counter
-    view: gather the fat row (``blk // J``), offset the word index into
-    lane group ``blk % J`` — same nibble decode as
-    :func:`blocked_counting_membership`, shared by the single-chip and
+    view: one fat-row gather per key, then each counter's word selected
+    by a lane-compare masked reduce (k dense [B, 128] passes — NOT
+    take_along_axis, which scalarizes on TPU; same nibble decode as
+    :func:`blocked_counting_membership`). Shared by the single-chip and
     sharded fat query paths."""
     J = 128 // w
-    rows128 = blocks_fat[(blk // J).astype(jnp.int32)]  # [B, 128]
-    lane0 = ((blk % J) * w).astype(jnp.int32)[:, None]
-    word = lane0 + (cpos >> jnp.uint32(3)).astype(jnp.int32)  # [B, k]
-    nib = (cpos & jnp.uint32(7)) * jnp.uint32(4)
-    vals = jnp.take_along_axis(rows128, word, axis=1)
-    cnt = (vals >> nib) & _u32(15)
-    return jnp.all(cnt > 0, axis=-1)
+    rf = (blk // J).astype(jnp.int32)
+    lane0 = ((blk % J) * w).astype(jnp.int32)
+    rows128 = blocks_fat[rf]  # [B, 128] row gather
+    lane = lax.broadcasted_iota(jnp.int32, rows128.shape, 1)
+    ok = None
+    k = cpos.shape[-1]
+    for i in range(k):
+        li = lane0 + (cpos[:, i] >> jnp.uint32(3)).astype(jnp.int32)
+        vi = jnp.sum(
+            jnp.where(lane == li[:, None], rows128, _u32(0)),
+            axis=1, dtype=jnp.uint32,
+        )  # [B] — the selected word (exactly one lane matches)
+        cnt = (vi >> ((cpos[:, i] & jnp.uint32(7)) * jnp.uint32(4))) & _u32(15)
+        hit = cnt > 0
+        ok = hit if ok is None else (ok & hit)
+    return ok

@@ -962,8 +962,8 @@ def choose_fat_params(
             # (18.0-19.6M scoped requests) or at 64 bodies with
             # bodies*KJP*R8 = 2.1M (bb=256 J=16 R8=512). Insert-only
             # bodies are lighter — 256 validated. The presence bound
-            # also keeps slot columns (t*J+j)*pack+u within the
-            # 128-lane presence tile.
+            # also keeps the kernel's slot columns t*J+j within its
+            # 128-lane presence tile (it implies s * J <= 64).
             pk = fat_pack(w, presence)
             bodies = s * J * pk
             if bodies > (64 if presence else 256):
@@ -1092,10 +1092,19 @@ def _fat_kernel(
         fetch(1 - slot, p + 1)
 
     wait(slot)
-    # presence slots live in a [KJP, 128] tile per grid step: slot u of
-    # packed row r in window (j, q=p*S+t) at row r, column
-    # (t*J + j)*PACK + u (requires S*J*PACK <= 128 — chooser-enforced).
-    pres_acc = jnp.zeros((KJP, 128), jnp.uint32) if PRES else None
+    KJC = PACK * KJP  # unpacked update slots per window
+    # presence slots live in a [KJC, 128] tile per grid step: slot
+    # (u, packed row r) of window (j, q=p*S+t) at row u*KJP + r,
+    # column t*J + j (requires S*J <= 128 — chooser-enforced). One
+    # [KJP, 128] accumulator per slot index u: idxp1 stays a raw lane
+    # slice (concatenating those does not lower — "offset mismatch on
+    # non-concat dimension"), and the accumulators land in pres_ref at
+    # static 8-aligned sublane offsets.
+    pres_accs = (
+        [jnp.zeros((KJP, 128), jnp.uint32) for _ in range(PACK)]
+        if PRES
+        else None
+    )
     colsR = lax.broadcasted_iota(jnp.int32, (KJP, R8), 1)
     colp = (
         lax.broadcasted_iota(jnp.int32, (KJP, 128), 1) if PRES else None
@@ -1114,57 +1123,37 @@ def _fat_kernel(
             sub0 = sup_ref[slot, j, pl.ds(rel, KJP), :]  # [KJP, 128]
             a0 = a_big(j, p) + rel  # packed-row units
             end = starts_ref[qi + 1]
-            if PRES:
-                tj = tile[:, j * W : (j + 1) * W]
-                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
             # PACK update slots per fetched row, slot u at lanes
-            # [u*STRIDE, u*STRIDE + 1 + W (+1)). Each slot runs its own
-            # one-hot placement and the counts ADD (same total MACs as
-            # one big matmul; Mosaic cannot sublane-concat lane-sliced
-            # vectors — "offset mismatch on non-concat dimension").
+            # [u*STRIDE, u*STRIDE + 1 + W (+1)). Mosaic cannot
+            # sublane-concat lane-SLICED vectors ("offset mismatch on
+            # non-concat dimension"), but COMPUTED one-hots and
+            # bit-planes concat fine — so each slot builds its own
+            # [KJP, *] oh/bits and the window still runs ONE
+            # KJC-contraction placement matmul (per-slot matmuls at
+            # M=KJP measured 15% SLOWER end-to-end: the DMA they were
+            # meant to amortize was already overlapped).
             # PACK=1 reduces to the original single-window pass.
-            cnt = None
+            ohs, bitss = [], []
             for u in range(PACK):
                 base = u * STRIDE
                 rl = (sub0[:, base : base + 1] - skey0).astype(jnp.int32)
-                oh_f32 = jnp.where(
-                    rl == colsR, jnp.float32(1), jnp.float32(0)
+                ohs.append(
+                    jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
                 )
-                bits = _expand_bits(sub0[:, base + 1 : base + 1 + W], KJP, W)
-                cnt_u = lax.dot_general(
-                    oh_f32.astype(jnp.int8), bits.astype(jnp.int8),
-                    (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32,
-                )  # [R8, W*32]
-                cnt = cnt_u if cnt is None else cnt + cnt_u
-                if PRES:
-                    # G[s, r] = popcount(mask_s AND oldrow_r): one int8
-                    # matmul; slot s was present iff its own row's count
-                    # equals popcount(mask_s)
-                    G = lax.dot_general(
-                        bits.astype(jnp.int8), tilebits,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.int32,
-                    )  # [KJP, R8]
-                    hit = jnp.sum(
-                        G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
-                    )
-                    npos = jnp.sum(
-                        bits.astype(jnp.int32), axis=1, keepdims=True
-                    )
-                    idxp1 = sub0[:, base + W + 1 : base + W + 2]
-                    # global UPDATE index of (packed row r, slot u)
-                    ipos = (a0 + iota_r) * PACK + u
-                    real = (
-                        (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
-                    )
-                    hbit = jnp.where(
-                        hit == npos, _u32(0x80000000), _u32(0)
-                    )
-                    v = jnp.where(real, idxp1 | hbit, _u32(0))
-                    pres_acc = pres_acc | jnp.where(
-                        colp == (t * J + j) * PACK + u, v, _u32(0)
-                    )
+                bitss.append(
+                    _expand_bits(sub0[:, base + 1 : base + 1 + W], KJP, W)
+                )
+            oh_f32 = (
+                jnp.concatenate(ohs, axis=0) if PACK > 1 else ohs[0]
+            )  # [KJC, R8]
+            bits = (
+                jnp.concatenate(bitss, axis=0) if PACK > 1 else bitss[0]
+            )  # [KJC, W*32]
+            cnt = lax.dot_general(
+                oh_f32.astype(jnp.int8), bits.astype(jnp.int8),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [R8, W*32]
             # NO in-kernel overflow chunks: a dynamic DMA loop in the body
             # defeats Mosaic's pipelining (measured +86% kernel time even
             # with zero iterations). Windows that overflow KJ (adversarial
@@ -1175,10 +1164,49 @@ def _fat_kernel(
                 cnt > 0, jnp.float32(1), jnp.float32(0)
             ).astype(jnp.bfloat16)
             deltas.append(_pack_planes(present_pl, W))
+
+            if PRES:
+                # G[s, r] = popcount(mask_s AND oldrow_r): one int8
+                # matmul over the full KJC window; slot s was present
+                # iff its own row's count equals popcount(mask_s)
+                tj = tile[:, j * W : (j + 1) * W]
+                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
+                G = lax.dot_general(
+                    bits.astype(jnp.int8), tilebits,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )  # [KJC, R8]
+                hit = jnp.sum(
+                    G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
+                )
+                npos = jnp.sum(bits.astype(jnp.int32), axis=1, keepdims=True)
+                for u in range(PACK):
+                    # 8-aligned sublane slices of the COMPUTED hit/npos
+                    # (KJP % 8 == 0) lower fine; the raw idxp1 lane
+                    # slice is used elementwise only
+                    hit_u = lax.slice_in_dim(hit, u * KJP, (u + 1) * KJP, axis=0)
+                    npos_u = lax.slice_in_dim(
+                        npos, u * KJP, (u + 1) * KJP, axis=0
+                    )
+                    idxp1 = sub0[
+                        :, u * STRIDE + W + 1 : u * STRIDE + W + 2
+                    ]  # [KJP, 1]
+                    ipos = (a0 + iota_r) * PACK + u
+                    real = (
+                        (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
+                    )
+                    hbit = jnp.where(
+                        hit_u == npos_u, _u32(0x80000000), _u32(0)
+                    )
+                    v = jnp.where(real, idxp1 | hbit, _u32(0))
+                    pres_accs[u] = pres_accs[u] | jnp.where(
+                        colp == t * J + j, v, _u32(0)
+                    )
         delta_fat = jnp.concatenate(deltas, axis=1)  # [R8, J*W = 128]
         out_ref[sl, :] = tile | delta_fat
     if PRES:
-        pres_ref[:] = pres_acc
+        for u in range(PACK):
+            pres_ref[pl.ds(u * KJP, KJP), :] = pres_accs[u]
 
 
 def fat_sweep_insert(
@@ -1203,23 +1231,25 @@ def fat_sweep_insert(
     1..W, original index + 1 in col W+1 (presence), ``>= KBJ + 8`` rows
     of sentinel tail padding; ``starts``: ``int32[J*P8 + 1]`` window
     boundaries, j-major. Returns the updated fat view, plus — with
-    presence — ``uint32[P*KJ, 128]`` slot-value tiles (slot i of window
-    (j, q) at row ``(q // S)*KJ + i``, column ``(q % S)*J + j``, value
-    ``idx+1 | was_present << 31``; 0 = empty slot)."""
+    presence — ``uint32[P*KJC, 128]`` slot-value tiles, where
+    ``KJC = pack * _packed_rows(KJ, pack)``: slot (u, packed row r) of
+    window (j, q) at row ``(q // S)*KJC + u*KJP + r``, column
+    ``(q % S)*J + j``, value ``idx+1 | was_present << 31``; 0 = empty
+    slot. ``_fat_unsort_presence`` is the one consumer of this layout."""
     NB8, L = blocks_fat.shape
     assert L == 128
     P8 = NB8 // R8
     P = P8 // S
-    kjp = _packed_rows(KJ, pack)  # presence rows per grid step
+    kjc = pack * _packed_rows(KJ, pack)  # presence rows per grid step
     kbjp = _packed_rows(KBJ, pack)  # big-fetch rows (packed units)
     out_shape = jax.ShapeDtypeStruct((NB8, 128), jnp.uint32)
     out_spec = pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0))
     if with_presence:
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((P * kjp, 128), jnp.uint32),
+            jax.ShapeDtypeStruct((P * kjc, 128), jnp.uint32),
         )
-        out_spec = (out_spec, pl.BlockSpec((kjp, 128), lambda p, *_: (p, 0)))
+        out_spec = (out_spec, pl.BlockSpec((kjc, 128), lambda p, *_: (p, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(P,),
@@ -1334,23 +1364,20 @@ def _fat_window_overflow(starts, *, J, P8, S, KJ, KBJ, pack=1):
     return jnp.max(need_end - a) > kjp
 
 
-def _fat_unsort_presence(
-    presb, starts, B, *, J, NBJ, P8, R8, S, KJ, KBJ, pack=1
-):
+def _fat_unsort_presence(presb, starts, B, *, J, NBJ, P8, R8, S, KJ, KBJ):
     """Presence tiles -> bool[B] in original key order via the vkey
     single-column unsort (idx+1 rides bits 1.., verdict the LSB; empty
-    slots sink to the tail). ``KJ`` here is the PACKED rows per window
-    (KJP); slot u of window (j, q) rides column (t*J + j)*pack + u."""
+    slots sink to the tail). ``KJ`` here is the slots per window (KJC =
+    pack * KJP when the stream is packed); window (j, q) rides column
+    t*J + j of its grid step's tile."""
     P = P8 // S
-    jqu = jnp.arange(J * P8 * pack, dtype=jnp.int32)
-    jq = jqu // pack
-    u = jqu % pack
+    jq = jnp.arange(J * P8, dtype=jnp.int32)
     j = jq // P8
     q = jq % P8
     p0 = q // S
     t = q % S
     presT = presb.reshape(P, KJ, 128).transpose(0, 2, 1).reshape(P * 128, KJ)
-    v = presT[p0 * 128 + (t * J + j) * pack + u]  # [J*P8*pack, KJ]
+    v = presT[p0 * 128 + t * J + j]  # [J*P8, KJ]
     vkey = jnp.where(
         v == 0,
         _u32(0xFFFFFFFE),  # even: empty slots must read as hit=0
@@ -1472,7 +1499,7 @@ def apply_fat_updates(
         )
         present = _fat_unsort_presence(
             presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
-            KJ=_packed_rows(KJ, pack), KBJ=KBJ, pack=pack,
+            KJ=pack * _packed_rows(KJ, pack), KBJ=KBJ,
         )
         return from_fat(new_fat), present
 
@@ -1586,31 +1613,36 @@ def _fat_count_kernel(
             rel = ((starts_ref[qi] // PACK) // _ALIGN) * _ALIGN - a_big(j, p)
             rel = jnp.clip(rel, 0, KBJP - KJP)
             sub = sup_ref[slot, j, pl.ds(rel, KJP), :]  # [KJP, 128]
-            # per-slot accumulation (Mosaic cannot sublane-concat
-            # lane-sliced vectors); counts ADD across slots, same total
-            # MACs. PACK=1 reduces to the original single pass.
-            cnts = None
+            # per-slot COMPUTED one-hots/nibble-planes concat along the
+            # contraction axis (raw lane slices cannot sublane-concat in
+            # Mosaic, computed values can), so the window still runs ONE
+            # KJC-contraction matmul. PACK=1 reduces to the original
+            # single pass.
+            ohs, nibfs = [], []
             for u in range(PACK):
                 base = u * STRIDE
                 rl = (sub[:, base : base + 1] - skey0).astype(jnp.int32)
-                oh = jnp.where(
-                    rl == colsR, jnp.float32(1), jnp.float32(0)
-                ).astype(jnp.bfloat16)  # [KJP, R8]; sentinels match nothing
+                ohs.append(
+                    jnp.where(
+                        rl == colsR, jnp.float32(1), jnp.float32(0)
+                    ).astype(jnp.bfloat16)
+                )  # [KJP, R8]; sentinels match nothing
                 m = sub[:, base + 1 : base + 1 + W]  # [KJP, W] nibbles
                 rep = jnp.concatenate([m] * 8, axis=1)  # [KJP, CPB]
                 nib = (
                     rep >> ((colC // W).astype(jnp.uint32) * _u32(4))
                 ) & _u32(15)
-                nibf = (
+                nibfs.append(
                     nib.astype(jnp.int32)
                     .astype(jnp.float32)
                     .astype(jnp.bfloat16)
                 )
-                cnt_u = lax.dot_general(
-                    oh, nibf, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )  # [R8, CPB], exact (<= 15 * KJP * PACK < 2^24)
-                cnts = cnt_u if cnts is None else cnts + cnt_u
+            oh = jnp.concatenate(ohs, axis=0) if PACK > 1 else ohs[0]
+            nibf = jnp.concatenate(nibfs, axis=0) if PACK > 1 else nibfs[0]
+            cnts = lax.dot_general(
+                oh, nibf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R8, CPB], exact (<= 15 * KJP * PACK < 2^24)
             acc = jnp.minimum(cnts, jnp.float32(16))
             tj = tile[:, j * W : (j + 1) * W]
             trep = jnp.concatenate([tj] * 8, axis=1)  # [R8, CPB]

@@ -301,10 +301,10 @@ def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
         blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
         if fat_store:
             flat = blocks_block.reshape(-1, 128)
-            blk, masks = blocked.fat_fold_masks(blk, masks, 128 // w)
+            verdict = blocked.fat_blocked_query(flat, blk, masks)
         else:
             flat = blocks_block.reshape(-1, w)
-        verdict = blocked.blocked_query(flat, blk, masks)
+            verdict = blocked.blocked_query(flat, blk, masks)
         one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
         hit = jax.lax.psum(one_hot, AXIS)
         return hit > 0

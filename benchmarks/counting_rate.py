@@ -20,7 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpubloom.config import FilterConfig
-from tpubloom.filter import blocked_device_shape, make_blocked_counter_fn
+from tpubloom.filter import (
+    blocked_device_shape,
+    blocked_storage_fat,
+    make_blocked_counter_fn,
+)
 
 B = 1 << 22
 KEY_LEN = 16
@@ -35,8 +39,9 @@ def main():
         m=1 << 30, k=7, key_len=KEY_LEN, counting=True, block_bits=512
     )
     lengths = jnp.full((B,), KEY_LEN, jnp.int32)
-    ins = make_blocked_counter_fn(config, increment=True, storage_fat=True)
-    dele = make_blocked_counter_fn(config, increment=False, storage_fat=True)
+    fat = blocked_storage_fat(config)  # matches blocked_device_shape
+    ins = make_blocked_counter_fn(config, increment=True, storage_fat=fat)
+    dele = make_blocked_counter_fn(config, increment=False, storage_fat=fat)
 
     def step(state, carry, i):
         # seed depends ONLY on i // 2 so step 2n+1 deletes exactly the

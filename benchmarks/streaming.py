@@ -21,6 +21,7 @@ this stack — benchmarks/RESULTS_r3.md §1).
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import tempfile
@@ -35,10 +36,17 @@ from tpubloom.config import FilterConfig
 from tpubloom.filter import BlockedBloomFilter, make_blocked_insert_fn
 from tpubloom.parallel.pipeline import StreamInserter
 
-LOG2M = 30
+_ap = argparse.ArgumentParser()
+_ap.add_argument("--log2m", type=int, default=30)
+_ap.add_argument("--total-mkeys", type=int, default=128)
+_ap.add_argument("--ckpt-every-steps", type=int, default=8)
+_ap.add_argument("--skip-host-fed", action="store_true")
+_ARGS = _ap.parse_args()
+
+LOG2M = _ARGS.log2m
 B = 1 << 22
-TOTAL = 128 * (1 << 20)  # 128M keys
-CKPT_EVERY_STEPS = 8  # 8 * 4M = 32M keys between snapshots
+TOTAL = _ARGS.total_mkeys * (1 << 20)
+CKPT_EVERY_STEPS = _ARGS.ckpt_every_steps  # default 8 * 4M = 32M keys
 
 config = FilterConfig(
     m=1 << LOG2M, k=7, key_len=16, block_bits=512, key_name="stream-bench"
@@ -46,8 +54,13 @@ config = FilterConfig(
 
 
 def device_stream(with_checkpoints: bool, tmpdir: str) -> dict:
+    from tpubloom.filter import blocked_storage_fat
+
     f = BlockedBloomFilter(config)
-    insert = make_blocked_insert_fn(config)
+    # the class holds FAT storage since r4 — the raw insert fn must match
+    insert = make_blocked_insert_fn(
+        config, storage_fat=blocked_storage_fat(config)
+    )
     lengths = jnp.full((B,), 16, jnp.int32)
 
     def step(state, seed):
@@ -107,6 +120,10 @@ def host_fed(prefetch: int, n_keys: int = 1 << 21) -> dict:
 
 def main():
     with tempfile.TemporaryDirectory() as tmp:
+        shape = {"log2m": LOG2M, "total_keys": TOTAL,
+                 "snapshot_mb": (1 << LOG2M) // 8 // (1 << 20),
+                 "ckpt_every_keys": CKPT_EVERY_STEPS * B}
+        print(json.dumps({"mode": "shape", **shape}), flush=True)
         base = device_stream(False, tmp)
         print(json.dumps({"mode": "device-stream no-ckpt", **base}), flush=True)
         with_ck = device_stream(True, tmp)
@@ -125,8 +142,9 @@ def main():
             ),
             flush=True,
         )
-    for pf in (0, 4):
-        print(json.dumps({"mode": "host-fed", **host_fed(pf)}), flush=True)
+    if not _ARGS.skip_host_fed:
+        for pf in (0, 4):
+            print(json.dumps({"mode": "host-fed", **host_fed(pf)}), flush=True)
 
 
 if __name__ == "__main__":

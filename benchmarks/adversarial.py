@@ -116,10 +116,10 @@ def check_bits(log2m, block_bits, storage_fat, B) -> bool:
     return ok_cfg
 
 
-def check_counting(B) -> bool:
+def check_counting(B, log2m=30, block_bits=512) -> bool:
     """Fat counting kernel vs flat-counting scatter ref on real Mosaic."""
     config = FilterConfig(
-        m=1 << 30, k=7, key_len=16, block_bits=512, counting=True
+        m=1 << log2m, k=7, key_len=16, block_bits=block_bits, counting=True
     )
     NB, W = config.n_blocks, config.words_per_block
     cpb = config.counters_per_block
@@ -168,7 +168,7 @@ def check_counting(B) -> bool:
         ok = exact_i and exact_d
         ok_all &= ok
         emit({
-            "check": f"counting m=2^30 bb=512 fat=True {name}",
+            "check": f"counting m=2^{log2m} bb={block_bits} fat=True {name}",
             "pack": fat_pack(W, False),
             "insert_x2_exact": exact_i,
             "delete_exact": exact_d,
@@ -192,6 +192,10 @@ def main() -> int:
     ok &= check_bits(32, 1024, True, B)  # W=32, pack=1 fallback
     ok &= check_bits(28, 512, True, 1 << 20)  # small filter: other (R8, S)
     ok &= check_counting(B)
+    # bb=256 (J=16) counting: the shape whose plane expansions OOMed the
+    # pre-bound chooser (RESULTS_r4 §3); small B keeps the slow scatter
+    # REFERENCE affordable
+    ok &= check_counting(1 << 19, log2m=29, block_bits=256)
     emit({"all_ok": ok})
     return 0 if ok else 1
 

@@ -17,11 +17,14 @@ from tpubloom.ops.sweep import (
     log2_nb=st.integers(min_value=3, max_value=26),
     log2_b=st.integers(min_value=4, max_value=25),
     w=st.sampled_from([4, 8, 16, 32, 64]),
-    presence=st.booleans(),
+    kind=st.sampled_from(["insert", "presence", "counting"]),
 )
-def test_choose_fat_params_always_valid(log2_nb, log2_b, w, presence):
+def test_choose_fat_params_always_valid(log2_nb, log2_b, w, kind):
+    presence = kind == "presence"
     nb, batch = 1 << log2_nb, 1 << log2_b
-    out = choose_fat_params(nb, batch, w, presence=presence)
+    out = choose_fat_params(
+        nb, batch, w, presence=presence, counting=kind == "counting"
+    )
     if out is None:
         return
     J, R8, S, KJ, KBJ = out
@@ -35,15 +38,21 @@ def test_choose_fat_params_always_valid(log2_nb, log2_b, w, presence):
     lam = batch * R8 // nb
     assert KJ >= min(1024, lam), "window must cover expected occupancy"
     bodies = S * J * fat_pack(w, presence)
+    volume = bodies * _packed_rows(KJ, fat_pack(w, presence)) * R8
     if presence:
         assert S * R8 <= 512, "presence kernels cap the tile at 512 fat rows"
         assert bodies <= 64, (
             "presence S*J*PACK unroll must fit Mosaic's scoped-VMEM stack "
             "(measured: OOM at 128 bodies)"
         )
-        assert S * J * fat_pack(w, presence) <= 128, "slot columns fit 128 lanes"
+        assert S * J <= 128, "slot columns fit 128 lanes"
+        assert volume <= 1_100_000, "presence operand-volume bound"
+    elif kind == "counting":
+        assert bodies <= 256
+        assert volume <= 2_200_000, "counting operand-volume bound"
     else:
         assert bodies <= 256, "insert-only unroll bound (validated at 256)"
+        assert volume <= 4_300_000, "insert operand-volume bound"
     # VMEM budget: windows (PACKED rows) + in/out/pres tiles with headroom
     sup_rows = _packed_rows(KBJ, fat_pack(w, presence))
     assert (

@@ -765,7 +765,7 @@ def make_sweep_counter_fn(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=cpb, k=k, seed=seed, block_hash=bh,
         )
-        fat = choose_fat_params(nb, keys_u8.shape[0], w)
+        fat = choose_fat_params(nb, keys_u8.shape[0], w, counting=True)
         if fat is not None:
             return apply_fat_counter_updates(
                 blocks, blk, cpos, valid,
@@ -903,7 +903,8 @@ def apply_blocked_updates(
 
 
 def choose_fat_params(
-    nb: int, batch: int, words_per_block: int = 16, *, presence: bool = False
+    nb: int, batch: int, words_per_block: int = 16, *, presence: bool = False,
+    counting: bool = False,
 ):
     """(J, R8, S, KJ, KBJ) for the fat sweep, or None if the shape does
     not qualify (callers fall back to the legacy kernel / scatter).
@@ -968,7 +969,21 @@ def choose_fat_params(
             bodies = s * J * pk
             if bodies > (64 if presence else 256):
                 continue
-            if presence and bodies * _packed_rows(KJ, pk) * R8 > 1_100_000:
+            # per-body operand volume, bounded per KERNEL KIND (all
+            # limits sit just above the largest hardware-validated
+            # shape of that kind and below its smallest measured OOM):
+            # presence bodies carry oh+G [KJP, R8] pairs (1.05M ship,
+            # 2.1M OOM); the counting kernel's plane expansions OOM at
+            # 4.2M units (J=16/R8=512 requested 17.5M scoped) with
+            # 2.1M validated; the plain insert kernel is bit-exact at
+            # 4.2M (probed) — its bound only fences untested corners.
+            volume = bodies * _packed_rows(KJ, pk) * R8
+            cap_v = (
+                1_100_000 if presence
+                else 2_200_000 if counting
+                else 4_300_000
+            )
+            if volume > cap_v:
                 continue
             kbj = ((lam * s + KJ + 64 + 7) // 8) * 8
             # scoped-VMEM estimate: double-buffered windows + block tiles

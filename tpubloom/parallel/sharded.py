@@ -434,7 +434,9 @@ def make_sharded_blocked_counter_fn(
         if fat_store:
             flat = blocks_block.reshape(-1, 128)
             fat_params = (
-                sweep.choose_fat_params(local_rows, max(1, B // n_dev), w)
+                sweep.choose_fat_params(
+                    local_rows, max(1, B // n_dev), w, counting=True
+                )
                 if use_sweep
                 else None
             )

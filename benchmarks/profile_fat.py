@@ -68,7 +68,7 @@ P8 = NBJ // R8
 FAT_SHAPE = (NB * W // 128, 128)
 lengths = jnp.full((B,), KEY_LEN, jnp.int32)
 
-OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "profile_fat_r4.json")
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "profile_fat_r5.json")
 _rows = []
 
 

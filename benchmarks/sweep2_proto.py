@@ -1,5 +1,9 @@
 #!/usr/bin/env python
-"""sweep2 prototype — acting on the round-2/3 ablation data (VERDICT r3 #1).
+"""sweep2 prototype — HISTORICAL (round-3 evidence; superseded by the
+pack-4 fat kernel in tpubloom/ops/sweep.py — do not use for current
+numbers, see benchmarks/RESULTS_r4.md).
+
+Acting on the round-2/3 ablation data (VERDICT r3 #1).
 
 Measured facts at the north-star shape (B=4M, m=2^32, bb=512, R=512,
 KMAX=384, P=16384) from kernel_ablate on this chip:

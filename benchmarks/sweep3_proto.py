@@ -1,5 +1,9 @@
 #!/usr/bin/env python
-"""sweep3 prototype — fat-row (128-lane) partition sweep.
+"""sweep3 prototype — HISTORICAL (round-3 evidence; its kernel graduated
+into tpubloom/ops/sweep.py as the shipping fat sweep — do not use for
+current numbers, see benchmarks/RESULTS_r4.md).
+
+Fat-row (128-lane) partition sweep.
 
 hbm_probe.py measured the decisive fact: Pallas DMA of this chip moves
 [*, 16]-lane tiles at ~35 GB/s but [*, 128]-lane tiles at ~150-190 GB/s

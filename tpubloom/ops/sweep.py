@@ -1181,28 +1181,54 @@ def _fat_kernel(
             deltas.append(_pack_planes(present_pl, W))
 
             if PRES:
-                # G[s, r] = popcount(mask_s AND oldrow_r): one int8
-                # matmul over the full KJC window; slot s was present
-                # iff its own row's count equals popcount(mask_s)
-                tj = tile[:, j * W : (j + 1) * W]
-                tilebits = _expand_bits(tj, R8, W).astype(jnp.int8)
-                G = lax.dot_general(
-                    bits.astype(jnp.int8), tilebits,
-                    (((1,), (1,)), ((), ())),
+                # Pre-batch membership by OLD-ROW EXTRACTION, not a
+                # G matmul: slot s's old block row is recovered nibble-
+                # exact with the placement one-hot ([KJC, R8] @ [R8, 8W]
+                # int8 — nibble values <= 15 times a 0/1 one-hot, i32
+                # accumulation), then the membership test is
+                # (old & mask) == mask on the nibble planes. This
+                # replaced r4's G = mask_bits @ tilebits^T (a W*32-deep
+                # contraction, 4x the MACs of this one) plus the
+                # [R8, W*32] tile bit expansion and [KJC, W*32] npos
+                # reduction that fed it — the two largest VPU surfaces
+                # of the r4 presence budget (benchmarks/RESULTS_r5.md).
+                # Slots whose row is outside this window extract row 0
+                # garbage; `real` masks them below, as before.
+                tj = tile[:, j * W : (j + 1) * W]  # [R8, W] u32
+                tn = jnp.concatenate(
+                    [
+                        ((tj >> _u32(4 * n)) & _u32(15)).astype(jnp.int8)
+                        for n in range(8)
+                    ],
+                    axis=1,
+                )  # [R8, 8W] old-row nibbles
+                rn = lax.dot_general(
+                    oh_f32.astype(jnp.int8), tn, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32,
-                )  # [KJC, R8]
-                hit = jnp.sum(
-                    G * oh_f32.astype(jnp.int32), axis=1, keepdims=True
-                )
-                npos = jnp.sum(bits.astype(jnp.int32), axis=1, keepdims=True)
+                )  # [KJC, 8W] per-slot old-row nibbles (one-hot-exact)
+                rn_u = rn.astype(jnp.uint32)
+                mns = []
                 for u in range(PACK):
-                    # 8-aligned sublane slices of the COMPUTED hit/npos
+                    mu = sub0[:, u * STRIDE + 1 : u * STRIDE + 1 + W]
+                    # computed shift/and outputs of the raw lane slice:
+                    # lane-concat then sublane-concat both lower (the
+                    # same pattern as the bits/one-hot builds above)
+                    mns.append(
+                        jnp.concatenate(
+                            [(mu >> _u32(4 * n)) & _u32(15) for n in range(8)],
+                            axis=1,
+                        )
+                    )
+                mn = jnp.concatenate(mns, axis=0) if PACK > 1 else mns[0]
+                okf = jnp.where(
+                    (mn & rn_u) == mn, jnp.float32(1), jnp.float32(0)
+                )
+                hit = jnp.min(okf, axis=1, keepdims=True)  # [KJC, 1] f32
+                for u in range(PACK):
+                    # 8-aligned sublane slices of the COMPUTED hit
                     # (KJP % 8 == 0) lower fine; the raw idxp1 lane
                     # slice is used elementwise only
                     hit_u = lax.slice_in_dim(hit, u * KJP, (u + 1) * KJP, axis=0)
-                    npos_u = lax.slice_in_dim(
-                        npos, u * KJP, (u + 1) * KJP, axis=0
-                    )
                     idxp1 = sub0[
                         :, u * STRIDE + W + 1 : u * STRIDE + W + 2
                     ]  # [KJP, 1]
@@ -1211,7 +1237,7 @@ def _fat_kernel(
                         (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
                     )
                     hbit = jnp.where(
-                        hit_u == npos_u, _u32(0x80000000), _u32(0)
+                        hit_u > 0.5, _u32(0x80000000), _u32(0)
                     )
                     v = jnp.where(real, idxp1 | hbit, _u32(0))
                     pres_accs[u] = pres_accs[u] | jnp.where(

@@ -87,7 +87,12 @@ def _use_local_sweep(
 ) -> bool:
     """Resolve config.insert_path for the per-device hot loop (the local
     row count, not the global filter, decides sweep applicability) —
-    delegates to the single resolve_insert_path funnel."""
+    delegates to the single resolve_insert_path funnel. ``batch`` must be
+    the EXPECTED OWNED count (~B / n_dev), matching the window-sizing
+    call: resolving with the full replicated batch would overstate
+    per-device occupancy by n_dev× and let a globally-dense but
+    per-device-sparse batch stream the whole local block array for a
+    handful of owned rows."""
     from tpubloom.ops import sweep
 
     return (
@@ -239,7 +244,7 @@ def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
         blk, masks, owned, bit = _routed_blocks(
             config, shards_per_dev, keys_u8, lengths, want_bit=True
         )
-        use_sweep = _use_local_sweep(config, local_rows, B)
+        use_sweep = _use_local_sweep(config, local_rows, max(1, B // n_dev))
         if fat_store:
             flat = blocks_block.reshape(-1, 128)  # [spd*NBLJ, 128]
             # window sizing uses the EXPECTED owned count (~B/n_dev):
@@ -421,7 +426,7 @@ def make_sharded_blocked_counter_fn(
         blk, cpos, owned = _routed_counter_blocks(
             config, shards_per_dev, keys_u8, lengths
         )
-        use_sweep = _use_local_sweep(config, local_rows, B)
+        use_sweep = _use_local_sweep(config, local_rows, max(1, B // n_dev))
         if use_sweep and config.k > 15:
             if config.insert_path == "sweep":
                 # match the single-chip contract (filter.py): a forced

@@ -138,6 +138,41 @@ def _run_bench(platform: str) -> dict:
         blk_insert, blk_query, blk_state, max(8, steps // 2)
     )
 
+    # each half on its own (VERDICT r5: the fused headline plus both
+    # single-op rates so the presence/query costs are visible)
+    def ins_step(state, seed):
+        keys = jax.random.bits(jax.random.key(seed), (B, key_len), jnp.uint8)
+        state = blk_insert(state, keys, lengths)
+        return state, jnp.sum(
+            state[:: max(1, state.shape[0] // 64)], dtype=jnp.uint32
+        )
+
+    ins_jit = jax.jit(ins_step, donate_argnums=0)
+    blk_state, acc = ins_jit(blk_state, 999)
+    _ = int(np.asarray(acc))
+    half_steps = max(8, steps // 2)
+    t0 = time.perf_counter()
+    for i in range(1000, 1000 + half_steps):
+        blk_state, acc = ins_jit(blk_state, i)
+    _ = int(np.asarray(acc))
+    insert_only_rate = B * half_steps / (time.perf_counter() - t0)
+
+    def qry_step(state, carry, seed):
+        keys = jax.random.bits(
+            jax.random.key(seed ^ (carry & 0xFF)), (B, key_len), jnp.uint8
+        )
+        hits = blk_query(state, keys, lengths)
+        return jnp.sum(hits.astype(jnp.uint32))
+
+    qry_jit = jax.jit(qry_step)
+    carry = qry_jit(blk_state, jnp.uint32(0), 0)
+    _ = int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(1, 1 + half_steps):
+        carry = qry_jit(blk_state, carry, i)
+    _ = int(np.asarray(carry))
+    query_only_rate = B * half_steps / (time.perf_counter() - t0)
+
     # -- reference-compatible flat layout (the Redis-bitmap position spec)
     config = FilterConfig(m=1 << log2m, k=7, key_len=key_len)
     insert = make_insert_fn(config)
@@ -168,8 +203,10 @@ def _run_bench(platform: str) -> dict:
 
     # FPR sanity at the end state of the flagship chain. Distinct-key
     # accounting: fused chain used seeds 0..steps; the split re-measure
-    # reuses a subset of those seeds, adding no distinct keys.
-    n_inserted = B * (1 + steps) + Bh
+    # reuses a subset of those seeds (no new distinct keys); the
+    # insert-only loop added 1 + half_steps batches at fresh seeds
+    # (999, 1000..); the query-only loop inserts nothing.
+    n_inserted = B * (1 + steps) + Bh + B * (1 + half_steps)
     probe = jax.random.bits(jax.random.key(10_000_019), (B, key_len), jnp.uint8)
     fpr = float(np.asarray(query_jit(blk_state, probe, lengths)).mean())
 
@@ -187,6 +224,8 @@ def _run_bench(platform: str) -> dict:
         "op": "fused test-and-insert (pre-batch membership + insert per key)",
         "insert_path": insert_path,
         "split_keys_per_sec": round(split_rate),
+        "insert_only_keys_per_sec": round(insert_only_rate),
+        "query_only_keys_per_sec": round(query_only_rate),
         "m": blk_config.m,
         "k": blk_config.k,
         "batch": B,

@@ -39,7 +39,7 @@ from tpubloom.filter import make_blocked_test_insert_fn
 from tpubloom.ops import blocked, counting
 from tpubloom.ops.sweep import choose_fat_params, fat_pack
 
-OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "adversarial_r4.json")
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "adversarial_r5.json")
 _rows = []
 
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Presence-kernel geometry sweep on REAL hardware (round 5).
+
+The r4 chooser caps (``S*J*PACK <= 64`` bodies, per-body operand volume
+<= 1.05M for presence) were measured against the OLD presence machinery
+(G matmul + [R8, 512] tile bit expansion). The r5 extraction presence
+kernel ([KJC, R8] @ [R8, 8W] int8 + nibble compares) has a much smaller
+scoped-VMEM footprint, so geometries the old kernel OOMed may now
+compile — and the r5 profile shows presence paying 2x the grid steps of
+insert-only (S=2 vs S=4 at R8=256). This probes candidate (R8, S)
+pairs directly: compile (Mosaic OOM surfaces as an exception), verify
+(fresh-batch presence all-false, replay all-true, final bits identical
+across geometries), and time a donated chain.
+
+Results feed choose_fat_params' presence caps; the probe is the
+measurement those constants cite.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH timeout 3000 python benchmarks/presence_geom.py
+Writes benchmarks/out/presence_geom_r5.json.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _fat_stream,
+    _fat_unsort_presence,
+    _pack_positions,
+    _packed_rows,
+    _unpack_positions,
+    fat_pack,
+    fat_sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+J = 128 // W
+NBJ = NB // J
+FAT_SHAPE = (NBJ, 128)
+PACK = fat_pack(W, True)
+
+CANDIDATES = [  # (R8, S)
+    (256, 2),  # shipping r4/r5 geometry
+    (256, 4),  # insert-only's S — blocked by the old bodies<=64 cap
+    (256, 8),
+    (512, 1),
+    (512, 2),
+    (128, 4),
+    (1024, 1),
+]
+
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "presence_geom_r5.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _kj_kbj(R8, S):
+    lam = B * R8 // NB
+    kj = max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8)
+    kbj = ((lam * S + kj + 64 + 7) // 8) * 8
+    return kj, kbj
+
+
+def _stream_for(R8, KBJ, keys):
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    P8 = NBJ // R8
+    j_of = (blk % J).astype(jnp.uint32)
+    rf_of = (blk // J).astype(jnp.uint32)
+    skey = j_of * NBJ + rf_of
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+    sorted_cols = lax.sort((skey,) + cols + (idx0,), num_keys=1)
+    ss = sorted_cols[0]
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return _fat_stream(
+        ss, masks, sorted_cols[-1], J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ,
+        W=W, pack=PACK,
+    )
+
+
+def main():
+    emit({
+        "shape": {
+            "m": config.m, "k": K, "B": B, "block_bits": BB, "J": J,
+            "pack": PACK, "platform": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "timing": "to-value chained loop, donated state",
+        }
+    })
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    ref_fat = None
+    for R8, S in CANDIDATES:
+        P8 = NBJ // R8
+        if P8 % S or (P8 // S) < 2:
+            emit({"R8": R8, "S": S, "skip": "grid shape"})
+            continue
+        KJ, KBJ = _kj_kbj(R8, S)
+        row = {"R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ,
+               "bodies": S * J * PACK,
+               "volume": S * J * PACK * _packed_rows(KJ, PACK) * R8}
+        try:
+            upd, starts = jax.jit(
+                lambda k, R8=R8, KBJ=KBJ: _stream_for(R8, KBJ, k)
+            )(keys)
+            kjc = PACK * _packed_rows(KJ, PACK)
+
+            def step(state, u, st):
+                new_fat, presb = fat_sweep_insert(
+                    state, u, st, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+                    with_presence=True, pack=PACK,
+                )
+                pres = _fat_unsort_presence(
+                    presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
+                    KJ=kjc, KBJ=KBJ,
+                )
+                return new_fat, jnp.sum(pres.astype(jnp.uint32))
+
+            jit = jax.jit(step, donate_argnums=(0,))
+            t0 = time.perf_counter()
+            state, n1 = jit(jnp.zeros(FAT_SHAPE, jnp.uint32), upd, starts)
+            n1 = int(np.asarray(n1))
+            row["compile_s"] = round(time.perf_counter() - t0, 1)
+            state, n2 = jit(state, upd, starts)
+            n2 = int(np.asarray(n2))
+            row["pres_pass1"] = n1  # fresh batch: expect 0
+            row["pres_pass2"] = n2  # replay: expect B
+            if ref_fat is None:
+                ref_fat = np.asarray(state)
+                row["bits_vs_ref"] = "is-ref"
+            else:
+                row["bits_vs_ref"] = bool((np.asarray(state) == ref_fat).all())
+            t0 = time.perf_counter()
+            acc = None
+            for i in range(STEPS):
+                state, acc = jit(state, upd, starts)
+            int(np.asarray(acc))
+            dt = (time.perf_counter() - t0) / STEPS
+            row["ms_per_step"] = round(dt * 1e3, 3)
+            row["ok"] = (n1 == 0) and (n2 == B) and row["bits_vs_ref"] in (
+                True, "is-ref"
+            )
+            del state
+        except Exception as e:  # Mosaic OOM / lowering errors land here
+            row["error"] = "".join(
+                traceback.format_exception_only(type(e), e)
+            )[:400]
+            row["ok"] = False
+        emit(row)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@ B=4M):
 
 1. WHERE does the 28.7M keys/s query rate go? Cumulative prefixes of
    the gather-query path: keygen -> +hash -> +masks+fold -> +gather ->
-   full compare. The gather of [B] 512-byte fat rows from the 4.3 GB
+   full compare. The gather of [B] 512-byte fat rows from the 512 MB
    array is the suspected floor (random HBM reads).
 2. Can the presence unsort's first stage be a GATHER? The kernel's
    slot-tile verdicts live at host-computable flat offsets; if a 1-D
@@ -81,16 +81,20 @@ def _positions(keys):
     )
 
 
-def run(name, step, *, steps=STEPS, extra=None):
-    """Chained to-value loop over ``step(carry, i) -> carry``."""
+def run(name, step, *operands, steps=STEPS, extra=None):
+    """Chained to-value loop over ``step(carry, i, *operands) -> carry``.
+
+    Large arrays MUST ride as ``operands``: a closed-over device array
+    becomes an HLO constant and the axon remote-compile request rejects
+    bodies past ~100 MB (HTTP 413)."""
     jit = jax.jit(step)
-    carry = jit(_u32(0), 0)
+    carry = jit(_u32(0), 0, *operands)
     int(np.asarray(carry))
-    carry = jit(carry, 1)
+    carry = jit(carry, 1, *operands)
     int(np.asarray(carry))
     t0 = time.perf_counter()
     for i in range(2, 2 + steps):
-        carry = jit(carry, i)
+        carry = jit(carry, i, *operands)
     int(np.asarray(carry))
     dt = (time.perf_counter() - t0) / steps
     row = {
@@ -143,7 +147,7 @@ def main():
         frow, m128 = blocked.fat_fold_masks(blk, masks, J)
         return jnp.sum(m128) + jnp.sum(frow.astype(jnp.uint32))
 
-    def q4(carry, i):
+    def q4(carry, i, fat):
         keys = keygen(carry, i)
         blk, bit = _positions(keys)
         masks = blocked.build_masks(bit, W)
@@ -153,7 +157,7 @@ def main():
         # slice into the gather and narrow the 512B-row fetch to 4B/row
         return jnp.sum(rows128, dtype=jnp.uint32) + jnp.sum(m128[:, 0])
 
-    def q5(carry, i):
+    def q5(carry, i, fat):
         keys = keygen(carry, i)
         blk, bit = _positions(keys)
         masks = blocked.build_masks(bit, W)
@@ -170,7 +174,8 @@ def main():
         ("q4 +gather", q4),
         ("q5 full query", q5),
     ]:
-        dt = run(name, fn)
+        ops = (fat,) if name in ("q4 +gather", "q5 full query") else ()
+        dt = run(name, fn, *ops)
         deltas[name] = dt - prev
         prev = dt
     emit({
@@ -179,7 +184,7 @@ def main():
     })
 
     # gather in ISOLATION (no hash chain): random fat-row gather + touch
-    def g_only(carry, i):
+    def g_only(carry, i, fat):
         h = jax.random.bits(
             jax.random.key(i ^ (carry & 0xFFFF)), (B,), jnp.uint32
         )
@@ -188,7 +193,7 @@ def main():
         # full-row reduce pins the gather at its real 512B/row width
         return jnp.sum(rows, dtype=jnp.uint32)
 
-    run("gather_only [B] x 512B fat rows", g_only,
+    run("gather_only [B] x 512B fat rows", g_only, fat,
         extra={"bytes_gathered": B * 512})
 
     # compare in ISOLATION: rows already gathered, fold + compare only
@@ -198,7 +203,7 @@ def main():
         )
     )
 
-    def c_only(carry, i):
+    def c_only(carry, i, rows_pre):
         keys = keygen(carry, i)
         blk, bit = _positions(keys)
         masks = blocked.build_masks(bit, W)
@@ -208,20 +213,19 @@ def main():
             jnp.all((r & m128) == m128, axis=-1).astype(jnp.uint32)
         )
 
-    run("compare_only (hash+masks+fold+allcmp, no gather)", c_only)
+    run("compare_only (hash+masks+fold+allcmp, no gather)", c_only, rows_pre)
 
     # ---- 2. unsort-gather probes ----
-    NSLOT = 2 * B  # the r4 slot-tile count is ~2.1x B
     flat_src = jax.random.bits(jax.random.key(5), (4 * B,), jnp.uint32)
 
-    def take1d(carry, i):
+    def take1d(carry, i, flat_src):
         idx = (
             jax.random.bits(jax.random.key(i ^ (carry & 0xFFFF)), (B,), jnp.uint32)
             & _u32(4 * B - 1)
         ).astype(jnp.int32)
         return jnp.sum(flat_src[idx])
 
-    run("take1d: flat[idx] B from 16.8M u32", take1d)
+    run("take1d: flat[idx] B from 16.8M u32", take1d, flat_src)
 
     # ---- 3. radix kill data ----
     def scatter_rows(carry, i):
@@ -235,25 +239,23 @@ def main():
 
     run("scatter: zeros(B).at[idx].set (4M u32)", scatter_rows, steps=4)
 
+    def sort1(carry, i, src):
+        (s,) = lax.sort((src ^ carry,), num_keys=1)
+        return jnp.sum(s)
+
     for n, lab in [(B, "4M"), (2 * B, "8.4M-ish")]:
         src = jax.random.bits(jax.random.key(11), (n,), jnp.uint32)
+        run(f"lax.sort 1 u32 col, n={lab}", sort1, src)
 
-        def sort1(carry, i, src=src):
-            (s,) = lax.sort((src ^ carry,), num_keys=1)
-            return jnp.sum(s)
-
-        run(f"lax.sort 1 u32 col, n={lab}", sort1)
+    def sort4(carry, i, s0, s1, s2, s3):
+        out = lax.sort((s0 ^ carry, s1, s2, s3), num_keys=1)
+        return sum(jnp.sum(c) for c in out).astype(jnp.uint32)
 
     src4 = [
         jax.random.bits(jax.random.fold_in(jax.random.key(13), i), (B,), jnp.uint32)
         for i in range(4)
     ]
-
-    def sort4(carry, i):
-        out = lax.sort((src4[0] ^ carry,) + tuple(src4[1:]), num_keys=1)
-        return sum(jnp.sum(c) for c in out).astype(jnp.uint32)
-
-    run("lax.sort 4 u32 cols, n=4M", sort4)
+    run("lax.sort 4 u32 cols, n=4M", sort4, *src4)
 
     # histogram via one-hot matmul (the radix COUNT pass, for the record:
     # counting is cheap — placement is what kills the radix sort)

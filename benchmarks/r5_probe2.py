@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Round-5 probe batch 2: insert-only kernel geometry + gather ordering.
+
+1. INSERT-ONLY GEOMETRY. presence_geom_r5.json showed the fused kernel
+   is per-window-overhead-bound (R8=512 beats R8=256 despite 2x the
+   placement MACs). The insert-only kernel ships at the r4-validated
+   (R8=256, S=4); this probes larger tiles with the same
+   compile/verify/time protocol. Results feed choose_fat_params'
+   insert-only lambda target and volume cap.
+
+2. GATHER ORDERING. The random [B] 512B-row gather costs 12.3 ns/row
+   (query_probe_r5.json). If XLA's row gather runs at HBM bandwidth
+   when the indices are ASCENDING, a sort->gather->unsort query path
+   beats both the random gather and a dedicated sweep query kernel.
+   Measured here: the same gather on (a) fixed random and (b) fixed
+   ascending row indices.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH timeout 3000 python benchmarks/r5_probe2.py
+Writes benchmarks/out/r5_probe2.json.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _fat_stream,
+    _pack_positions,
+    _packed_rows,
+    _unpack_positions,
+    fat_pack,
+    fat_sweep_insert,
+)
+
+LOG2M = 32
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+J = 128 // W
+NBJ = NB // J
+FAT_SHAPE = (NBJ, 128)
+PACK = fat_pack(W, False)
+
+CANDIDATES = [  # (R8, S) for insert-only
+    (256, 4),   # shipping r4-validated geometry
+    (256, 8),
+    (512, 2),
+    (512, 4),
+    (1024, 1),
+    (1024, 2),
+]
+
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "r5_probe2.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _kj_kbj(R8, S):
+    lam = B * R8 // NB
+    kj = max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8)
+    kbj = ((lam * S + kj + 64 + 7) // 8) * 8
+    return kj, kbj
+
+
+def _stream_for(R8, KBJ, keys):
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+    P8 = NBJ // R8
+    j_of = (blk % J).astype(jnp.uint32)
+    rf_of = (blk // J).astype(jnp.uint32)
+    skey = j_of * NBJ + rf_of
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    sorted_cols = lax.sort((skey,) + cols, num_keys=1)
+    ss = sorted_cols[0]
+    bit_sorted = _unpack_positions(sorted_cols[1:], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return _fat_stream(
+        ss, masks, None, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=W, pack=PACK,
+    )
+
+
+def insert_geometry(keys):
+    ref_fat = None
+    for R8, S in CANDIDATES:
+        P8 = NBJ // R8
+        if P8 % S or (P8 // S) < 2:
+            emit({"probe": "insert-geom", "R8": R8, "S": S, "skip": "grid"})
+            continue
+        KJ, KBJ = _kj_kbj(R8, S)
+        row = {
+            "probe": "insert-geom", "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ,
+            "bodies": S * J * PACK,
+            "volume": S * J * PACK * _packed_rows(KJ, PACK) * R8,
+        }
+        try:
+            upd, starts = jax.jit(
+                lambda k, R8=R8, KBJ=KBJ: _stream_for(R8, KBJ, k)
+            )(keys)
+
+            def step(state, u, st):
+                new_fat = fat_sweep_insert(
+                    state, u, st, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+                    pack=PACK,
+                )
+                return new_fat, jnp.sum(
+                    new_fat[:: max(1, NBJ // 64)], dtype=jnp.uint32
+                )
+
+            jit = jax.jit(step, donate_argnums=(0,))
+            t0 = time.perf_counter()
+            state, acc = jit(jnp.zeros(FAT_SHAPE, jnp.uint32), upd, starts)
+            int(np.asarray(acc))
+            row["compile_s"] = round(time.perf_counter() - t0, 1)
+            if ref_fat is None:
+                ref_fat = np.asarray(state)
+                row["bits_vs_ref"] = "is-ref"
+            else:
+                row["bits_vs_ref"] = bool((np.asarray(state) == ref_fat).all())
+            t0 = time.perf_counter()
+            for i in range(STEPS):
+                state, acc = jit(state, upd, starts)
+            int(np.asarray(acc))
+            dt = (time.perf_counter() - t0) / STEPS
+            row["ms_per_step"] = round(dt * 1e3, 3)
+            row["keys_per_sec"] = round(B / dt)
+            row["ok"] = row["bits_vs_ref"] in (True, "is-ref")
+            del state
+        except Exception as e:
+            row["error"] = "".join(
+                traceback.format_exception_only(type(e), e)
+            )[:300]
+            row["ok"] = False
+        emit(row)
+
+
+def gather_ordering():
+    fill = jax.random.bits(jax.random.key(99), FAT_SHAPE, jnp.uint32)
+    fat = jnp.asarray(fill & fill >> 1 & fill >> 2 & _u32(0x11111111))
+    rng = np.random.default_rng(3)
+    idx_rand = rng.integers(0, NBJ, B).astype(np.int32)
+    idx_sort = np.sort(idx_rand)
+    for name, idx in [("random", idx_rand), ("ascending", idx_sort)]:
+        idx_d = jax.device_put(jnp.asarray(idx))
+
+        def step(carry, i, fat, idx_d):
+            # carry threads the chain (device executes serially; the
+            # to-value sync at the end is the only timing fence needed)
+            rows = fat[idx_d]
+            return jnp.sum(rows, dtype=jnp.uint32) + carry
+
+        jit = jax.jit(step)
+        carry = jit(_u32(0), 0, fat, idx_d)
+        int(np.asarray(carry))
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            carry = jit(carry, i, fat, idx_d)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({
+            "probe": "gather-order", "order": name,
+            "ms_per_step": round(dt * 1e3, 3),
+            "ns_per_row": round(dt / B * 1e9, 3),
+            "gb_per_sec": round(B * 512 / dt / 1e9, 1),
+        })
+
+
+def main():
+    emit({
+        "shape": {
+            "m": config.m, "k": K, "B": B, "block_bits": BB, "J": J,
+            "pack": PACK, "platform": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "timing": "to-value chained loop, donated state",
+        }
+    })
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    insert_geometry(keys)
+    gather_ordering()
+
+
+if __name__ == "__main__":
+    main()

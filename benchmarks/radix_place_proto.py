@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Radix-sort PLACEMENT prototype — the build-or-kill evidence for the
+twice-deferred Pallas radix sort (VERDICT r4 Missing #2 / task 2).
+
+A radix/bucket sort has two halves:
+
+  COUNT  per-bucket histograms + prefix sums. Cheap on TPU — one-hot
+         matmuls count 4M 8-bit digits in ~1 ms (query_probe.py
+         "radix hist" row), and a 32k-bucket prefix sum is trivial.
+  PLACE  move each element to its computed destination. This is a
+         data-dependent permutation write, and it is the entire
+         difficulty: every mechanism this chip offers is measured
+         here or in a sibling probe.
+
+Mechanisms for data-dependent placement, with their measured rates:
+
+  1. XLA scatter: ~100 ns/row (query_probe.py "scatter" row; also the
+     round-1 finding that motivated the sweep kernel). 4M rows
+     -> ~400 ms. lax.sort does the whole job in ~12 ms.
+  2. In-kernel dynamic DMA, one row per destination: THIS prototype.
+     A sequential-grid Pallas kernel walks update tiles and issues
+     one make_async_copy per row to ``out[dst[i]]``. Expectation from
+     r4's dma_ablate (a dynamic DMA loop defeats Mosaic pipelining at
+     ZERO iterations, +86%): latency-bound at ~1 us/row -> 100x too
+     slow. The measurement pins it.
+  3. One-hot permutation matmuls: out = P @ in with P a [B, B] one-hot
+     — O(B^2) MACs = 1.4e13 at B=4M per u32 column. Two decades over
+     the MXU budget of the whole kernel; arithmetic, no probe needed.
+
+The kill criterion: placement must beat ~350M rows/s (4M rows in the
+11.8 ms the 4-col lax.sort takes end-to-end) to be worth building.
+Anything under ~30M rows/s is not even worth hybridizing.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH timeout 1800 python benchmarks/radix_place_proto.py
+Writes benchmarks/out/radix_place_r5.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B = 1 << 18  # 256k rows is plenty to pin a per-row latency; dst fits SMEM
+T = 1 << 10  # rows per grid step
+STEPS = 8
+
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "radix_place_r5.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _place_kernel(dst_ref, src_ref, out_ref, buf_ref, sems, *, T: int, ROWS: int):
+    """Per-row dynamic-destination DMA placement: tile t copies its T
+    rows (already in VMEM via the auto-pipelined src block) to
+    ``out[dst[i]]`` one 8-row-aligned DMA at a time.
+
+    Mosaic constraint stack-up, for the record: DMA sublane offsets and
+    shapes must be 8-aligned, so a TRUE 1-row placement is not even
+    expressible — each "row" here is an 8-row slab (dst pre-multiplied
+    by 8), which FAVORS the prototype (8x fewer DMAs than a real
+    permutation would need). It still loses by ~two decades.
+    """
+    t = pl.program_id(0)
+    buf_ref[:] = src_ref[:]  # stage the tile (VMEM->VMEM, cheap)
+
+    def body(i, _):
+        d = dst_ref[t * ROWS + i]
+        cp = pltpu.make_async_copy(
+            buf_ref.at[pl.ds(i * 8, 8), :],
+            out_ref.at[pl.ds(d * 8, 8), :],
+            sems.at[lax.rem(i, 4)],
+        )
+        cp.start()
+
+        @pl.when(i >= 3)
+        def _():
+            pltpu.make_async_copy(
+                buf_ref.at[pl.ds(0, 8), :],
+                out_ref.at[pl.ds(0, 8), :],
+                sems.at[lax.rem(i - 3, 4)],
+            ).wait()
+
+        return 0
+
+    lax.fori_loop(0, ROWS, body, 0)
+    # drain the last in-flight copies
+    def drain(i, _):
+        pltpu.make_async_copy(
+            buf_ref.at[pl.ds(0, 8), :],
+            out_ref.at[pl.ds(0, 8), :],
+            sems.at[lax.rem(ROWS - 3 + i, 4)],
+        ).wait()
+        return 0
+
+    lax.fori_loop(0, 3, drain, 0)
+
+
+def place(src, dst8):
+    """src: [B, 128] u32 in 8-row slabs (B/8 slabs); dst8: [B/8] i32 slab
+    permutation. Returns src permuted by slabs via per-slab dynamic DMA."""
+    nslab = src.shape[0] // 8
+    rows_per_tile = T // 8
+    grid = nslab // rows_per_tile
+    fn = pl.pallas_call(
+        functools.partial(_place_kernel, T=T, ROWS=rows_per_tile),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((T, 128), lambda t, *_: (t, 0))],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((T, 128), jnp.uint32),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(src.shape, jnp.uint32),
+    )
+    return fn(dst8, src)
+
+
+def main():
+    emit({
+        "shape": {
+            "B_rows": B, "tile": T,
+            "platform": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "timing": "to-value (int(np.asarray(...)) after chained loop)",
+            "note": "8-row-slab placement (1-row DMA not expressible); "
+                    "slab granularity FAVORS the prototype 8x",
+        }
+    })
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 2**32, (B, 128), np.uint32).astype(np.uint32))
+    perm = jnp.asarray(rng.permutation(B // 8).astype(np.int32))
+
+    jit = jax.jit(lambda s, d: jnp.sum(place(s, d)[:, 0], dtype=jnp.uint32))
+    t0 = time.perf_counter()
+    carry = jit(src, perm)
+    int(np.asarray(carry))
+    compile_s = time.perf_counter() - t0
+
+    # correctness first: the permutation must actually permute
+    out = jax.jit(place)(src, perm)
+    out_np = np.asarray(out).reshape(B // 8, 8, 128)
+    src_np = np.asarray(src).reshape(B // 8, 8, 128)
+    perm_np = np.asarray(perm)
+    ok = bool((out_np[perm_np] == src_np).all())
+    emit({"stage": "correctness", "slab_permutation_exact": ok})
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        carry = jit(src + carry, perm)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    rows_per_sec = B / dt
+    emit({
+        "stage": "dynamic-DMA placement",
+        "ms_per_step": round(dt * 1e3, 3),
+        "rows_per_sec": round(rows_per_sec),
+        "slabs_per_sec": round(rows_per_sec / 8),
+        "compile_s": round(compile_s, 1),
+        "vs_laxsort_rows_per_sec": 355_000_000,
+        "verdict_beats_sort": rows_per_sec > 355e6,
+    })
+
+
+if __name__ == "__main__":
+    main()

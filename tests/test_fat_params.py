@@ -40,13 +40,17 @@ def test_choose_fat_params_always_valid(log2_nb, log2_b, w, kind):
     bodies = S * J * fat_pack(w, presence)
     volume = bodies * _packed_rows(KJ, fat_pack(w, presence)) * R8
     if presence:
-        assert S * R8 <= 512, "presence kernels cap the tile at 512 fat rows"
-        assert bodies <= 64, (
+        assert S * R8 <= 1024, "tile cap (1024 fat rows validated r5)"
+        assert bodies <= 128, (
             "presence S*J*PACK unroll must fit Mosaic's scoped-VMEM stack "
-            "(measured: OOM at 128 bodies)"
+            "(r5 extraction kernel: 128 bodies validated, OOM at 256 — "
+            "benchmarks/out/presence_geom_r5.json)"
         )
         assert S * J <= 128, "slot columns fit 128 lanes"
-        assert volume <= 1_100_000, "presence operand-volume bound"
+        assert volume <= 3_500_000, (
+            "presence operand-volume bound (3.41M validated, 4.19M/6.03M "
+            "OOM — presence_geom_r5.json)"
+        )
     elif kind == "counting":
         assert bodies <= 256
         assert volume <= 2_200_000, "counting operand-volume bound"

@@ -186,16 +186,27 @@ def blocked_insert(
 def fat_blocked_query(
     blocks_fat: jnp.ndarray, blk: jnp.ndarray, masks: jnp.ndarray
 ) -> jnp.ndarray:
-    """Membership against the fat [NB/J, 128] view: fold each key's mask
-    to its lane group (O(B) VPU) and compare against the gathered fat
-    row. Plain row gathers + full-row compares are the ONLY fast shapes
-    here: take_along_axis and multi-index lax.gather both scalarize on
-    TPU (measured: 9x and 54x collapses of the split query rate at
-    B=4M)."""
+    """Membership against the fat [NB/J, 128] view: gather each key's fat
+    row, compare the mask against every lane group with STATIC slices,
+    select the owning group's verdict. Plain row gathers + static-slice
+    compares are the fast shapes here: take_along_axis and multi-index
+    lax.gather scalarize (measured r4: 9x and 54x collapses), and the
+    previous fold-to-128-lanes path (``fat_fold_masks``) paid a hidden
+    relayout — lane-concatenating a [B, W] array costs a real cross-row
+    shuffle because [B, W] is already 128-lane padded in TPU layout
+    (measured r5: the fold alone was ~47 ms at B=4M,
+    benchmarks/out/query_probe_r5.json q3). J narrow compares are ~1.6G
+    VPU element-ops — noise by comparison."""
     w = masks.shape[-1]
-    frow, m128 = fat_fold_masks(blk, masks, 128 // w)
+    J = 128 // w
+    frow = (blk // J).astype(jnp.int32)
     rows128 = blocks_fat[frow]  # [B, 128] row gather
-    return jnp.all((rows128 & m128) == m128, axis=-1)
+    g = (blk % J).astype(jnp.int32)
+    hit = jnp.zeros(blk.shape, bool)
+    for j in range(J):
+        rj = rows128[..., j * w : (j + 1) * w]
+        hit = hit | ((g == j) & jnp.all((rj & masks) == masks, axis=-1))
+    return hit
 
 
 def blocked_query(

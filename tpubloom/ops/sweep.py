@@ -912,9 +912,10 @@ def choose_fat_params(
     J = blocks per 128-lane fat row; R8 = fat rows per placement
     sub-tile; S = sub-tiles per grid step (DMA granularity); KJ = update
     slots per (substream, sub-tile) window (lambda + 8 sigma, multiple
-    of 8); KBJ = rows per substream big-window fetch. Presence kernels
-    cap S*R8 at 512 fat rows — larger tiles blow the 16 MiB VMEM scoped
-    limit (measured: 24.5M requested at S*R8=1024)."""
+    of 8); KBJ = rows per substream big-window fetch. Tiles cap at
+    S*R8 = 1024 fat rows; within that, the measured per-kind body/volume
+    caps below (r5: presence_geom_r5.json) separate compiling shapes
+    from Mosaic scoped-VMEM OOMs."""
     import math
 
     w = words_per_block
@@ -929,7 +930,13 @@ def choose_fat_params(
     if J < 1 or w * J != 128 or nb % J:
         return None
     NBJ = nb // J
-    cap = 512 if presence else 1024
+    cap = 1024
+    # lambda target: the kernel is per-window-overhead-bound, not
+    # MAC-bound, so presence prefers lambda ~ 256 (R8=512 at the
+    # north-star shape): measured 66.2 ms vs 74.0 ms for lambda ~ 128
+    # (benchmarks/out/presence_geom_r5.json). Insert-only/counting keep
+    # the r4-validated lambda ~ 128 target.
+    lam_target = 8 if presence else 7
     candidates = []
     for r8 in (32, 64, 128, 256, 512, 1024):
         if r8 > NBJ or NBJ % r8:
@@ -937,7 +944,7 @@ def choose_fat_params(
         lam = batch * r8 // nb
         if lam < 8:
             continue
-        score = abs(math.log2(max(lam, 1)) - 7)  # prefer lambda ~ 128
+        score = abs(math.log2(max(lam, 1)) - lam_target)
         candidates.append((score, r8, lam))
     # feasibility (grid depth, lane columns, VMEM) is checked per
     # candidate, best score first — a smaller R8 may qualify where the
@@ -955,31 +962,32 @@ def choose_fat_params(
             if P8 % s or s * R8 > cap or P8 // s < 2:
                 continue
             # Mosaic's scoped-VMEM stack grows with the fully-unrolled
-            # S*J*PACK inner-body count AND each presence body's
-            # [KJP, R8] oh/G matmul operands. Measured on v5e (r4
-            # probes, benchmarks/out/adversarial_r4.json): presence
-            # compiles at 64 bodies with bodies*KJP*R8 <= 1.05M
-            # (the shipping bb=512 shape) but OOMs at 128 bodies
-            # (18.0-19.6M scoped requests) or at 64 bodies with
-            # bodies*KJP*R8 = 2.1M (bb=256 J=16 R8=512). Insert-only
-            # bodies are lighter — 256 validated. The presence bound
-            # also keeps the kernel's slot columns t*J+j within its
-            # 128-lane presence tile (it implies s * J <= 64).
+            # S*J*PACK inner-body count AND each body's [KJP, R8]
+            # matmul operands. Bounds are measured per KERNEL KIND,
+            # each just above the largest hardware-validated shape of
+            # that kind and below its smallest measured OOM:
+            # * presence (r5 extraction kernel,
+            #   benchmarks/out/presence_geom_r5.json): compiles at
+            #   128 bodies / 2.10M volume and 64 bodies / 3.41M,
+            #   OOMs at 256 bodies / 4.19M and 32 bodies / 6.03M
+            #   -> bodies <= 128 AND volume <= 3.5M. (The r4 G-matmul
+            #   kernel OOMed at 128 bodies; the extraction kernel's
+            #   scoped stack is much smaller.) The bodies bound also
+            #   keeps slot columns t*J+j within the 128-lane presence
+            #   tile (s * J <= 128 always holds at pack=4 since
+            #   s*J*pk <= 128 => s*J <= 32; at pack=1, w >= 32 so
+            #   s*J <= bodies/1 <= 128 with J <= 4).
+            # * counting: plane expansions OOM at 4.2M units
+            #   (J=16/R8=512 requested 17.5M scoped), 2.1M validated.
+            # * plain insert: bit-exact at 4.2M (probed r4); its bound
+            #   only fences untested corners.
             pk = fat_pack(w, presence)
             bodies = s * J * pk
-            if bodies > (64 if presence else 256):
+            if bodies > (128 if presence else 256):
                 continue
-            # per-body operand volume, bounded per KERNEL KIND (all
-            # limits sit just above the largest hardware-validated
-            # shape of that kind and below its smallest measured OOM):
-            # presence bodies carry oh+G [KJP, R8] pairs (1.05M ship,
-            # 2.1M OOM); the counting kernel's plane expansions OOM at
-            # 4.2M units (J=16/R8=512 requested 17.5M scoped) with
-            # 2.1M validated; the plain insert kernel is bit-exact at
-            # 4.2M (probed) — its bound only fences untested corners.
             volume = bodies * _packed_rows(KJ, pk) * R8
             cap_v = (
-                1_100_000 if presence
+                3_500_000 if presence
                 else 2_200_000 if counting
                 else 4_300_000
             )
@@ -1110,18 +1118,16 @@ def _fat_kernel(
     KJC = PACK * KJP  # unpacked update slots per window
     # presence slots live in a [KJC, 128] tile per grid step: slot
     # (u, packed row r) of window (j, q=p*S+t) at row u*KJP + r,
-    # column t*J + j (requires S*J <= 128 — chooser-enforced). One
-    # [KJP, 128] accumulator per slot index u: idxp1 stays a raw lane
-    # slice (concatenating those does not lower — "offset mismatch on
-    # non-concat dimension"), and the accumulators land in pres_ref at
-    # static 8-aligned sublane offsets.
-    pres_accs = (
-        [jnp.zeros((KJP, 128), jnp.uint32) for _ in range(PACK)]
-        if PRES
-        else None
-    )
+    # column t*J + j (requires S*J <= 128 — chooser-enforced). ONE
+    # [KJC, 128] accumulator: per-slot values are computed at [KJP, 1]
+    # (idxp1 stays a raw lane slice — those cannot sublane-concat, but
+    # their COMPUTED where() outputs can), concatenated u-major to match
+    # the tile row order, and merged with a single [KJC, 128] select/OR
+    # per window (4 separate [KJP, 128] chains measurably pay 4x the
+    # instruction issue on this overhead-bound kernel).
+    pres_acc = jnp.zeros((PACK * KJP, 128), jnp.uint32) if PRES else None
     colsR = lax.broadcasted_iota(jnp.int32, (KJP, R8), 1)
-    colp = (
+    colpu = (
         lax.broadcasted_iota(jnp.int32, (KJP, 128), 1) if PRES else None
     )
     iota_r = lax.broadcasted_iota(jnp.int32, (KJP, 1), 0)
@@ -1224,10 +1230,17 @@ def _fat_kernel(
                     (mn & rn_u) == mn, jnp.float32(1), jnp.float32(0)
                 )
                 hit = jnp.min(okf, axis=1, keepdims=True)  # [KJC, 1] f32
+                vus = []
                 for u in range(PACK):
                     # 8-aligned sublane slices of the COMPUTED hit
                     # (KJP % 8 == 0) lower fine; the raw idxp1 lane
-                    # slice is used elementwise only
+                    # slice is used elementwise only. Each slot's value
+                    # is SELECTED into its tile column BEFORE the
+                    # sublane concat: a [KJP, 1] where() output keeps
+                    # its source slice's lane-offset layout and Mosaic
+                    # refuses to concat mismatched offsets ("offset
+                    # mismatch on non-concat dimension"), while the
+                    # [KJP, 128] where-broadcast is standard-layout.
                     hit_u = lax.slice_in_dim(hit, u * KJP, (u + 1) * KJP, axis=0)
                     idxp1 = sub0[
                         :, u * STRIDE + W + 1 : u * STRIDE + W + 2
@@ -1240,14 +1253,15 @@ def _fat_kernel(
                         hit_u > 0.5, _u32(0x80000000), _u32(0)
                     )
                     v = jnp.where(real, idxp1 | hbit, _u32(0))
-                    pres_accs[u] = pres_accs[u] | jnp.where(
-                        colp == t * J + j, v, _u32(0)
-                    )
+                    vus.append(jnp.where(colpu == t * J + j, v, _u32(0)))
+                v128 = (
+                    jnp.concatenate(vus, axis=0) if PACK > 1 else vus[0]
+                )  # [KJC, 128], u-major — the tile's row order
+                pres_acc = pres_acc | v128
         delta_fat = jnp.concatenate(deltas, axis=1)  # [R8, J*W = 128]
         out_ref[sl, :] = tile | delta_fat
     if PRES:
-        for u in range(PACK):
-            pres_ref[pl.ds(u * KJP, KJP), :] = pres_accs[u]
+        pres_ref[:] = pres_acc
 
 
 def fat_sweep_insert(

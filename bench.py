@@ -134,8 +134,9 @@ def _run_bench(platform: str) -> dict:
     # split (separate insert step + query step) rate, for comparison.
     # >= 8 steps: the to-value sync carries a large one-time cost on the
     # axon tunnel and short sections over-report per-step time.
+    split_steps = max(8, steps // 2)
     split_rate, _, _, blk_state = measure(
-        blk_insert, blk_query, blk_state, max(8, steps // 2)
+        blk_insert, blk_query, blk_state, split_steps
     )
 
     # each half on its own (VERDICT r5: the fused headline plus both
@@ -203,10 +204,16 @@ def _run_bench(platform: str) -> dict:
 
     # FPR sanity at the end state of the flagship chain. Distinct-key
     # accounting: fused chain used seeds 0..steps; the split re-measure
-    # reuses a subset of those seeds (no new distinct keys); the
+    # runs seeds 0..split_steps+1 (on the CPU fallback, steps=8, that
+    # reaches past the fused chain's seeds — count the excess); the
     # insert-only loop added 1 + half_steps batches at fresh seeds
     # (999, 1000..); the query-only loop inserts nothing.
-    n_inserted = B * (1 + steps) + Bh + B * (1 + half_steps)
+    n_inserted = (
+        B * (1 + steps)
+        + B * max(0, split_steps + 1 - steps)
+        + Bh
+        + B * (1 + half_steps)
+    )
     probe = jax.random.bits(jax.random.key(10_000_019), (B, key_len), jnp.uint8)
     fpr = float(np.asarray(query_jit(blk_state, probe, lengths)).mean())
 

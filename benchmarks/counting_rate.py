@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Blocked-counting insert/delete rate on the fat packed kernel
 (VERDICT r3 #4 "done =" clause: counting insert/delete rate, measured
-against the 26.1M ops/s round-1 narrow-tile figure).
+against the 26.1M ops/s round-1 narrow-tile figure), plus the counting
+QUERY rate (ADVICE r4: record the measurement justifying the k-pass
+masked-reduce in fat_blocked_counting_membership).
 
 m=2^30 counters (BASELINE config 4), k=7, blocked512, fat storage,
 B=4M device-generated keys, to-value timing, alternating insert/delete
 steps so the counter array stays bounded. Writes
-benchmarks/out/counting_rate_r4.json.
+benchmarks/out/counting_rate_r5.json.
 """
 
 from __future__ import annotations
@@ -30,7 +32,7 @@ B = 1 << 22
 KEY_LEN = 16
 STEPS = 16
 OUT_PATH = os.path.join(
-    os.path.dirname(__file__), "out", "counting_rate_r4.json"
+    os.path.dirname(__file__), "out", "counting_rate_r5.json"
 )
 
 
@@ -72,6 +74,31 @@ def main():
         state, carry = jit(state, carry, i)
     int(np.asarray(carry))
     dt = (time.perf_counter() - t0) / STEPS
+    # -- query rate (ADVICE r4 #3): fat_blocked_counting_membership runs
+    # k dense [B, 128] masked-reduce passes (take_along_axis scalarizes
+    # on TPU; two hashes may share a word, so a single combined lane
+    # select is incorrect). Measure it so the loop is justified by a
+    # number, like the other kernels.
+    from tpubloom.filter import make_blocked_counting_query_fn
+
+    qry = make_blocked_counting_query_fn(config, storage_fat=fat)
+
+    def qstep(state, carry, i):
+        keys = jax.random.bits(
+            jax.random.key(i ^ 0x5EED), (B, KEY_LEN), jnp.uint8
+        )
+        hits = qry(state, keys, lengths)
+        return carry ^ jnp.sum(hits.astype(jnp.uint32))
+
+    qjit = jax.jit(qstep)
+    carry = qjit(state, jnp.uint32(0), 0)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(1, 1 + STEPS):
+        carry = qjit(state, carry, i)
+    int(np.asarray(carry))
+    qdt = (time.perf_counter() - t0) / STEPS
+
     row = {
         "metric": "blocked counting insert/delete ops/sec (fat packed kernel)",
         "m_counters": config.m,
@@ -80,6 +107,12 @@ def main():
         "ms_per_step": round(dt * 1e3, 2),
         "ops_per_sec": round(B / dt),
         "vs_round1_narrow_tile": round(B / dt / 26.1e6, 2),
+        "query_ms_per_step": round(qdt * 1e3, 2),
+        "query_keys_per_sec": round(B / qdt),
+        "query_note": (
+            "fat_blocked_counting_membership: row gather + k dense "
+            "[B,128] masked-reduce word selects (ADVICE r4 #3 benchmark)"
+        ),
         "compile_s": round(compile_s, 1),
         "platform": jax.default_backend(),
         "timing": "to-value, 16 chained alternating insert/delete steps",

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Isolated A/B of fat blocked query variants (round 5).
+
+bench r5 exposed the shipping fold-free query at 18.9M keys/s (222 ms /
+4M step) — ~3x slower than the component arithmetic predicted. The
+suspect: STATIC lane slices ``rows128[:, j*w:(j+1)*w]`` are themselves
+cross-lane relayouts on this chip, paid J=8 times, just like the
+lane-concat the r5 fold fix removed (query_probe_r5 q3: ~47 ms for one
+[B, W] -> [B, 128] concat).
+
+Variants, same keys / same fat array / to-value timing:
+  A "slices"      — shipping r5 path: J static slices + narrow compares
+  B "matmul_fold" — replicate masks to 128 lanes via 4 exact
+                    byte-quarter matmuls (constant [W, 128] 0/1 weights,
+                    values <= 255 are bf16-exact), select owning group,
+                    ONE full-width compare + all-reduce
+  C "concat_fold" — r4 path: lane-concat fold (the known 47 ms relayout)
+  D "gather_only" — row gather + trivial reduce (floor for any variant)
+
+Writes benchmarks/out/query_fix_r5.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import blocked
+
+B = 1 << 22
+KEY_LEN = 16
+STEPS = 8
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "query_fix_r5.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def replicate_matmul(masks):
+    B_, w = masks.shape
+    iw = lax.broadcasted_iota(jnp.int32, (w, 128), 0)
+    il = lax.broadcasted_iota(jnp.int32, (w, 128), 1)
+    sel = (il % w == iw).astype(jnp.bfloat16)
+    out = jnp.zeros((B_, 128), jnp.uint32)
+    for b in range(4):
+        q = ((masks >> _u32(8 * b)) & _u32(0xFF)).astype(jnp.bfloat16)
+        rep = lax.dot_general(
+            q, sel, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        out = out | (rep.astype(jnp.uint32) << _u32(8 * b))
+    return out
+
+
+def main():
+    config = FilterConfig(m=1 << 32, k=7, key_len=KEY_LEN, block_bits=512)
+    nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
+    J = 128 // w
+    fat_rows = nb * w // 128
+    lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+    # a filled-ish array so compares aren't trivially short-circuitable
+    state = jax.random.bits(jax.random.key(7), (fat_rows, 128), jnp.uint32)
+
+    def front(seed):
+        keys = jax.random.bits(jax.random.key(seed), (B, KEY_LEN), jnp.uint8)
+        blk, bit = blocked.block_positions(
+            keys, lengths, n_blocks=nb, block_bits=bb, k=config.k,
+            seed=config.seed, block_hash=config.block_hash,
+        )
+        return blk, blocked.build_masks(bit, w)
+
+    def q_slices(state, carry, seed):
+        blk, masks = front(seed)
+        frow = (blk // J).astype(jnp.int32)
+        rows128 = state[frow]
+        g = (blk % J).astype(jnp.int32)
+        hit = jnp.zeros(blk.shape, bool)
+        for j in range(J):
+            rj = rows128[..., j * w:(j + 1) * w]
+            hit = hit | ((g == j) & jnp.all((rj & masks) == masks, axis=-1))
+        return carry ^ jnp.sum(hit.astype(jnp.uint32))
+
+    def q_matmul(state, carry, seed):
+        blk, masks = front(seed)
+        frow = (blk // J).astype(jnp.int32)
+        rows128 = state[frow]
+        lane = lax.broadcasted_iota(jnp.int32, (B, 128), 1)
+        sel = (lane // w) == (blk % J).astype(jnp.int32)[:, None]
+        m128 = jnp.where(sel, replicate_matmul(masks), _u32(0))
+        hit = jnp.all((rows128 & m128) == m128, axis=-1)
+        return carry ^ jnp.sum(hit.astype(jnp.uint32))
+
+    def q_concat(state, carry, seed):
+        blk, masks = front(seed)
+        frow, m128 = blocked.fat_fold_masks(blk, masks, J)
+        rows128 = state[frow]
+        hit = jnp.all((rows128 & m128) == m128, axis=-1)
+        return carry ^ jnp.sum(hit.astype(jnp.uint32))
+
+    def q_matmul_ornot(state, carry, seed):
+        # like B, but the verdict is "no missing bit": one and-not pass +
+        # a single OR-reduce (fewer [B, 128] intermediates than
+        # compare-eq + all-reduce)
+        blk, masks = front(seed)
+        frow = (blk // J).astype(jnp.int32)
+        rows128 = state[frow]
+        lane = lax.broadcasted_iota(jnp.int32, (B, 128), 1)
+        sel = (lane // w) == (blk % J).astype(jnp.int32)[:, None]
+        m128 = jnp.where(sel, replicate_matmul(masks), _u32(0))
+        missing = jnp.bitwise_and(jnp.bitwise_not(rows128), m128)
+        hit = lax.reduce(
+            missing, _u32(0), lax.bitwise_or, (1,)
+        ) == _u32(0)
+        return carry ^ jnp.sum(hit.astype(jnp.uint32))
+
+    def q_gather(state, carry, seed):
+        blk, masks = front(seed)
+        frow = (blk // J).astype(jnp.int32)
+        rows128 = state[frow]
+        return carry ^ (
+            jnp.sum(rows128[:, ::64], dtype=jnp.uint32)
+            ^ jnp.sum(masks, dtype=jnp.uint32)
+        )
+
+    variants = [
+        ("A slices", q_slices),
+        ("B matmul_fold", q_matmul),
+        ("C concat_fold", q_concat),
+        ("E matmul_ornot", q_matmul_ornot),
+        ("D gather_only", q_gather),
+    ]
+    emit({
+        "shape": f"m=2^32 k=7 blocked512 fat query, B={B}",
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "timing": f"to-value, {STEPS} chained steps",
+    })
+    ref = None
+    for name, fn in variants:
+        jit = jax.jit(fn)
+        t0 = time.perf_counter()
+        carry = jit(state, jnp.uint32(0), 0)
+        v0 = int(np.asarray(carry))
+        compile_s = time.perf_counter() - t0
+        # correctness cross-check: variants A-C must agree on the carry
+        if name != "D gather_only":
+            if ref is None:
+                ref = v0
+            elif v0 != ref:
+                emit({"variant": name, "MISMATCH": [ref, v0]})
+                continue
+        carry = jit(state, carry, 1)
+        int(np.asarray(carry))
+        t0 = time.perf_counter()
+        for i in range(2, 2 + STEPS):
+            carry = jit(state, carry, i)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({
+            "variant": name,
+            "ms_per_step": round(dt * 1e3, 2),
+            "keys_per_sec": round(B / dt),
+            "compile_s": round(compile_s, 1),
+        })
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

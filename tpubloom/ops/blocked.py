@@ -142,6 +142,34 @@ def build_masks(bit: jnp.ndarray, words_per_block: int) -> jnp.ndarray:
     return mask  # [B, W]
 
 
+def _replicate_masks_128(masks: jnp.ndarray) -> jnp.ndarray:
+    """[B, W] u32 -> [B, 128] with the mask repeated in every lane group,
+    via 4 exact byte-quarter matmuls against a constant [W, 128] 0/1
+    weight (byte values <= 255 are bf16-exact; f32 accumulation).
+
+    Why a matmul: a [B, W] array is ALREADY 128-lane padded in TPU
+    layout, so every lane-space alternative is a real cross-row
+    relayout at B=4M — ``concatenate([masks]*J, axis=1)`` costs ~47 ms
+    (benchmarks/out/query_probe_r5.json q3) and static lane slices of a
+    [B, 128] operand cost ~20 ms EACH (benchmarks/out/query_fix_r5.json
+    variant A, ~126 ms over the fold for J=8 slices). The MXU
+    replicates across lanes for free: measured 106 ms vs 232 ms (slices)
+    vs 114 ms (concat) for the full query step at B=4M."""
+    B, w = masks.shape
+    iw = lax.broadcasted_iota(jnp.int32, (w, 128), 0)
+    il = lax.broadcasted_iota(jnp.int32, (w, 128), 1)
+    sel = (il % w == iw).astype(jnp.bfloat16)  # [W, 128] 0/1
+    out = jnp.zeros((B, 128), jnp.uint32)
+    for b in range(4):
+        q = ((masks >> _u32(8 * b)) & _u32(0xFF)).astype(jnp.bfloat16)
+        rep = lax.dot_general(
+            q, sel, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        out = out | (rep.astype(jnp.uint32) << _u32(8 * b))
+    return out
+
+
 def fat_fold_masks(
     blk: jnp.ndarray, masks: jnp.ndarray, J: int
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -150,15 +178,18 @@ def fat_fold_masks(
     placed at lane group ``blk % J``. Lets the scatter/gather fallbacks
     operate on fat storage DIRECTLY — a [NB, W] <-> fat reshape is a
     real ~26 ms copy at m=2^32 on TPU (benchmarks/RESULTS_r3.md §2),
-    while this fold is O(B) VPU work. ``blocked_insert``/``blocked_query``
-    accept the folded pair unchanged (they are generic over row width;
-    distinct blocks sharing a fat row merge by OR at disjoint lanes).
+    while this fold is 4 constant-weight matmuls + one select (see
+    :func:`_replicate_masks_128` for why NOT lane concat or slices).
+    ``blocked_insert``/``blocked_query`` accept the folded pair
+    unchanged (they are generic over row width; distinct blocks sharing
+    a fat row merge by OR at disjoint lanes).
     """
     B, w = masks.shape
     lane = lax.broadcasted_iota(jnp.int32, (B, 128), 1)
     sel = (lane // w) == (blk % J).astype(jnp.int32)[:, None]
-    rep = jnp.concatenate([masks] * J, axis=1)  # [B, 128], chunk j = masks
-    return (blk // J).astype(jnp.int32), jnp.where(sel, rep, _u32(0))
+    return (blk // J).astype(jnp.int32), jnp.where(
+        sel, _replicate_masks_128(masks), _u32(0)
+    )
 
 
 def blocked_insert(
@@ -187,26 +218,20 @@ def fat_blocked_query(
     blocks_fat: jnp.ndarray, blk: jnp.ndarray, masks: jnp.ndarray
 ) -> jnp.ndarray:
     """Membership against the fat [NB/J, 128] view: gather each key's fat
-    row, compare the mask against every lane group with STATIC slices,
-    select the owning group's verdict. Plain row gathers + static-slice
-    compares are the fast shapes here: take_along_axis and multi-index
-    lax.gather scalarize (measured r4: 9x and 54x collapses), and the
-    previous fold-to-128-lanes path (``fat_fold_masks``) paid a hidden
-    relayout — lane-concatenating a [B, W] array costs a real cross-row
-    shuffle because [B, W] is already 128-lane padded in TPU layout
-    (measured r5: the fold alone was ~47 ms at B=4M,
-    benchmarks/out/query_probe_r5.json q3). J narrow compares are ~1.6G
-    VPU element-ops — noise by comparison."""
+    row, fold the mask to the owning lane group with the matmul
+    replication (:func:`_replicate_masks_128`), one full-width compare.
+
+    Every lane-space alternative measured slower at B=4M
+    (benchmarks/out/query_fix_r5.json): J static-slice compares 232 ms
+    (each slice is a hidden cross-lane relayout), lane-concat fold
+    114 ms, this path 106 ms against a 70 ms gather-only floor.
+    take_along_axis / multi-index lax.gather scalarize outright
+    (measured r4: 9x and 54x collapses)."""
     w = masks.shape[-1]
     J = 128 // w
-    frow = (blk // J).astype(jnp.int32)
+    frow, m128 = fat_fold_masks(blk, masks, J)
     rows128 = blocks_fat[frow]  # [B, 128] row gather
-    g = (blk % J).astype(jnp.int32)
-    hit = jnp.zeros(blk.shape, bool)
-    for j in range(J):
-        rj = rows128[..., j * w : (j + 1) * w]
-        hit = hit | ((g == j) & jnp.all((rj & masks) == masks, axis=-1))
-    return hit
+    return jnp.all((rows128 & m128) == m128, axis=-1)
 
 
 def blocked_query(

@@ -902,6 +902,71 @@ def apply_blocked_updates(
 # path (same blocked position spec).
 
 
+# Device generations whose fat-kernel operand-volume caps below are
+# hardware-measured (benchmarks/out/presence_geom_r5.json,
+# adversarial_r5.json). On any OTHER TPU generation the scoped-VMEM
+# limits may differ, so a geometry inside the caps is probe-compiled
+# once (AOT, cached) before being returned — unvalidated parts degrade
+# to the legacy/scatter path instead of erroring at first use.
+_VALIDATED_DEVICE_KINDS = ("TPU v5 lite",)
+_GEOM_PROBE_CACHE: dict = {}
+
+
+def _fat_geometry_compiles(
+    nb: int, w: int, geom, *, presence: bool, counting: bool
+) -> bool:
+    """True if the fat kernel at ``geom`` compiles on the current device.
+
+    v5e ("TPU v5 lite") skips the probe — the caps in
+    :func:`choose_fat_params` are measured there. Elsewhere the chosen
+    kernel is lowered + compiled AOT against ShapeDtypeStructs (no
+    operand allocation) in a try/except, one compile per geometry per
+    process. CPU/GPU backends return True unchanged: the sweep path is
+    never auto-selected off-TPU, and tests drive the kernel in
+    interpret mode where Mosaic limits don't apply."""
+    try:
+        if jax.default_backend() != "tpu":
+            return True
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return True
+    if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
+        return True
+    J, R8, S, KJ, KBJ = geom
+    key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting)
+    hit = _GEOM_PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    NBJ = nb // J
+    # pack must match the kernel the runtime will launch: both the
+    # chooser's volume bound and apply_fat_counter_updates use
+    # fat_pack(w, presence) — probing a pack=1 counting kernel would
+    # validate a strictly lighter scoped-VMEM footprint than the real
+    # PACK=4 unroll
+    pk = fat_pack(w, presence)
+    kbjp = _packed_rows(KBJ, pk)
+    blocks_sds = jax.ShapeDtypeStruct((NBJ, 128), jnp.uint32)
+    upd_sds = jax.ShapeDtypeStruct((kbjp + 16, 128), jnp.uint32)
+    starts_sds = jax.ShapeDtypeStruct((J * (NBJ // R8) + 1,), jnp.int32)
+    if counting:
+        fn = functools.partial(
+            fat_sweep_counter, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
+            increment=True, pack=pk,
+        )
+    else:
+        fn = functools.partial(
+            fat_sweep_insert, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
+            with_presence=presence, pack=pk,
+        )
+    try:
+        jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
+        ok = True
+    except Exception:
+        ok = False
+    _GEOM_PROBE_CACHE[key] = ok
+    return ok
+
+
 def choose_fat_params(
     nb: int, batch: int, words_per_block: int = 16, *, presence: bool = False,
     counting: bool = False,
@@ -1002,7 +1067,12 @@ def choose_fat_params(
                 2 * J * sup_rows * 128 * 4 + 4 * (s * R8 * 128 * 4)
                 <= 9 * 1024 * 1024
             ):
-                return J, R8, s, KJ, kbj
+                geom = (J, R8, s, KJ, kbj)
+                if not _fat_geometry_compiles(
+                    nb, w, geom, presence=presence, counting=counting
+                ):
+                    continue  # unvalidated device generation: next shape
+                return geom
     return None
 
 

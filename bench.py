@@ -56,9 +56,11 @@ def _run_bench(platform: str) -> dict:
     # North-star scale on TPU; reduced on the 1-core CPU fallback so the
     # benchmark terminates, with the scale reported in the JSON.
     if on_tpu:
-        # B = 4M amortizes the sweep kernel's per-partition fixed costs
-        # (measured +25% pair rate over B = 1M on v5e)
-        log2m, B, steps, key_len = 32, 1 << 22, 16, 16
+        # B = 8M is the measured optimum of the clean r5 batch sweep
+        # (benchmarks/out/b_sweep_r5.json: 40.5M keys/s vs 38.6M at 4M
+        # and an axon-compile wall at 16M); larger B amortizes the
+        # whole-array stream and the per-window fixed costs
+        log2m, B, steps, key_len = 32, 1 << 23, 16, 16
     else:
         log2m, B, steps, key_len = 26, 1 << 16, 8, 16
 

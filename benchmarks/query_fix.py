@@ -50,20 +50,21 @@ def _u32(x):
     return jnp.asarray(x, jnp.uint32)
 
 
-def replicate_matmul(masks):
+# variant B measures the SHIPPING helper (so re-runs track the tree);
+# variant C pins the historical r4 lane-concat inline (fat_fold_masks
+# itself now uses the matmul replication, so calling it would measure
+# B twice)
+replicate_matmul = blocked._replicate_masks_128
+
+
+def concat_fold_r4(blk, masks, J):
+    """The r4 fat_fold_masks body, pinned verbatim: lane-concat
+    replication (the measured ~47 ms relayout at B=4M)."""
     B_, w = masks.shape
-    iw = lax.broadcasted_iota(jnp.int32, (w, 128), 0)
-    il = lax.broadcasted_iota(jnp.int32, (w, 128), 1)
-    sel = (il % w == iw).astype(jnp.bfloat16)
-    out = jnp.zeros((B_, 128), jnp.uint32)
-    for b in range(4):
-        q = ((masks >> _u32(8 * b)) & _u32(0xFF)).astype(jnp.bfloat16)
-        rep = lax.dot_general(
-            q, sel, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        out = out | (rep.astype(jnp.uint32) << _u32(8 * b))
-    return out
+    lane = lax.broadcasted_iota(jnp.int32, (B_, 128), 1)
+    sel = (lane // w) == (blk % J).astype(jnp.int32)[:, None]
+    rep = jnp.concatenate([masks] * J, axis=1)
+    return (blk // J).astype(jnp.int32), jnp.where(sel, rep, _u32(0))
 
 
 def main():
@@ -107,7 +108,7 @@ def main():
 
     def q_concat(state, carry, seed):
         blk, masks = front(seed)
-        frow, m128 = blocked.fat_fold_masks(blk, masks, J)
+        frow, m128 = concat_fold_r4(blk, masks, J)
         rows128 = state[frow]
         hit = jnp.all((rows128 & m128) == m128, axis=-1)
         return carry ^ jnp.sum(hit.astype(jnp.uint32))

@@ -961,8 +961,21 @@ def _fat_geometry_compiles(
     try:
         jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
         ok = True
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — any compile failure demotes
         ok = False
+        import warnings
+
+        warnings.warn(
+            f"tpubloom: fat-sweep geometry {geom} failed its probe "
+            f"compile on device kind {kind!r}; this geometry is "
+            f"disabled for the process (falling back to the next "
+            f"shape / scatter path). NOTE: the probe cannot tell a "
+            f"real Mosaic limit from a transient compile-service "
+            f"error — restart the process to re-probe. Cause: "
+            f"{str(e)[:300]}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     _GEOM_PROBE_CACHE[key] = ok
     return ok
 
@@ -1032,16 +1045,20 @@ def choose_fat_params(
             # each just above the largest hardware-validated shape of
             # that kind and below its smallest measured OOM:
             # * presence (r5 extraction kernel,
-            #   benchmarks/out/presence_geom_r5.json): compiles at
-            #   128 bodies / 2.10M volume and 64 bodies / 3.41M,
-            #   OOMs at 256 bodies / 4.19M and 32 bodies / 6.03M
-            #   -> bodies <= 128 AND volume <= 3.5M. (The r4 G-matmul
-            #   kernel OOMed at 128 bodies; the extraction kernel's
-            #   scoped stack is much smaller.) The bodies bound also
-            #   keeps slot columns t*J+j within the 128-lane presence
-            #   tile (s * J <= 128 always holds at pack=4 since
-            #   s*J*pk <= 128 => s*J <= 32; at pack=1, w >= 32 so
-            #   s*J <= bodies/1 <= 128 with J <= 4).
+            #   benchmarks/out/presence_geom_r5.json + the B-sweep OOM
+            #   point): compiles at 128 bodies / 2.10M volume, 64
+            #   bodies / 3.41M, and 128 bodies / 1.70M; OOMs at 128
+            #   bodies / 3.41M (B=8M chooser corner — caught by the
+            #   clean r5 B-sweep, benchmarks/out/b_sweep_r5.json), 256
+            #   bodies / 4.19M, and 32 bodies / 6.03M. The bound is
+            #   JOINT: volume <= 3.5M overall AND volume <= 2.2M once
+            #   bodies exceed 64 (the scoped stack grows with both).
+            #   (The r4 G-matmul kernel OOMed at 128 bodies outright;
+            #   the extraction kernel's scoped stack is much smaller.)
+            #   The bodies bound also keeps slot columns t*J+j within
+            #   the 128-lane presence tile (s * J <= 128 always holds
+            #   at pack=4 since s*J*pk <= 128 => s*J <= 32; at pack=1,
+            #   w >= 32 so s*J <= bodies/1 <= 128 with J <= 4).
             # * counting: plane expansions OOM at 4.2M units
             #   (J=16/R8=512 requested 17.5M scoped), 2.1M validated.
             # * plain insert: bit-exact at 4.2M (probed r4); its bound
@@ -1056,6 +1073,8 @@ def choose_fat_params(
                 else 2_200_000 if counting
                 else 4_300_000
             )
+            if presence and bodies > 64:
+                cap_v = 2_200_000  # joint bound — see matrix above
             if volume > cap_v:
                 continue
             kbj = ((lam * s + KJ + 64 + 7) // 8) * 8

@@ -289,6 +289,12 @@ def main() -> None:
     attempts = []
     result, err = _spawn("tpu", TPU_TIMEOUT_S)
     if result is None:
+        # one retry: the axon tunnel's compile service intermittently
+        # drops connections ("response body closed", HTTP 500) — a
+        # transient failure must not record a CPU number for the round
+        attempts.append({"platform": "tpu", "error": err})
+        result, err = _spawn("tpu", TPU_TIMEOUT_S)
+    if result is None:
         attempts.append({"platform": "tpu", "error": err})
         result, err = _spawn("cpu", CPU_TIMEOUT_S)
     if result is None:

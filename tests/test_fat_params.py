@@ -51,6 +51,13 @@ def test_choose_fat_params_always_valid(log2_nb, log2_b, w, kind):
             "presence operand-volume bound (3.41M validated, 4.19M/6.03M "
             "OOM — presence_geom_r5.json)"
         )
+        if bodies > 64:
+            assert volume <= 2_200_000, (
+                "joint (bodies, volume) bound: 128 bodies x 3.41M is a "
+                "measured Mosaic OOM (the B=8M chooser corner the clean "
+                "r5 B-sweep caught — b_sweep_r5.json) while 128 x 2.10M "
+                "and 64 x 3.41M both compile"
+            )
     elif kind == "counting":
         assert bodies <= 256
         assert volume <= 2_200_000, "counting operand-volume bound"

@@ -288,10 +288,12 @@ def main() -> None:
         return
     attempts = []
     result, err = _spawn("tpu", TPU_TIMEOUT_S)
-    if result is None:
+    if result is None and not err.startswith("timeout after"):
         # one retry: the axon tunnel's compile service intermittently
         # drops connections ("response body closed", HTTP 500) — a
-        # transient failure must not record a CPU number for the round
+        # transient failure must not record a CPU number for the round.
+        # Timeouts are NOT retried: a hang repeats and would double the
+        # time to the CPU fallback.
         attempts.append({"platform": "tpu", "error": err})
         result, err = _spawn("tpu", TPU_TIMEOUT_S)
     if result is None:

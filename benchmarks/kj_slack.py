@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Window-slack sweep for the fused presence kernel (round 5).
+
+choose_fat_params sizes presence windows at KJ = lambda + max(16,
+8*sqrt(lambda)) — an 8-sigma Poisson slack. Every slack slot costs
+twice: the kernel processes KJP packed rows per window, and the unsort
+sorts J*P8*KJ slot rows. At the B=8M shipping geometry (256, 2,
+lambda=256) the 8-sigma window is KJ=384 = 1.5x occupancy.
+
+This probe re-times the full fused step at slack multipliers m in
+{8, 6, 4} (KJ = lambda + max(16, m*sqrt(lambda))), same keys, with the
+in-step replay assert (every replayed key must report present) as the
+correctness fence. Overflowing windows route the batch to the scatter
+fallback — correct but slow — so the probe also reports the overflow
+probability arithmetic per batch.
+
+Writes benchmarks/out/kj_slack_r5.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import blocked_storage_fat, make_blocked_test_insert_fn
+from tpubloom.ops import sweep
+
+B = 1 << 23
+KEY_LEN = 16
+STEPS = 8
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "kj_slack_r5.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+_orig_choose = sweep.choose_fat_params
+
+
+def _patched_choose(slack_mult):
+    @functools.wraps(_orig_choose)
+    def choose(nb, batch, words_per_block=16, *, presence=False,
+               counting=False):
+        out = _orig_choose(
+            nb, batch, words_per_block, presence=presence, counting=counting
+        )
+        if out is None or not presence or slack_mult == 8:
+            return out
+        J, R8, S, KJ, KBJ = out
+        lam = batch * R8 // nb
+        kj = max(16, (lam + max(16, int(slack_mult * math.sqrt(lam))) + 7)
+                 // 8 * 8)
+        kbj = ((lam * S + kj + 64 + 7) // 8) * 8
+        return J, R8, S, kj, kbj
+
+    return choose
+
+
+def run(slack_mult):
+    sweep.choose_fat_params = _patched_choose(slack_mult)
+    try:
+        config = FilterConfig(m=1 << 32, k=7, key_len=KEY_LEN, block_bits=512)
+        nb = config.n_blocks
+        geom = sweep.choose_fat_params(nb, B, 16, presence=True)
+        J, R8, S, KJ, KBJ = geom
+        lam = B * R8 // nb
+        # per-window overflow tail (Poisson upper bound) x window count
+        sig = math.sqrt(lam)
+        z = (KJ - lam) / sig
+        # Chernoff/normal tail approx — reported for context, not proof
+        p_tail = math.exp(-z * z / 2)
+        n_windows = J * (nb // J // R8)
+        fn = make_blocked_test_insert_fn(config, storage_fat=True)
+        assert blocked_storage_fat(config)
+        lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+        fat_rows = nb * 16 // 128
+        state = jnp.zeros((fat_rows, 128), jnp.uint32)
+
+        def step(state, seed):
+            keys = jax.random.bits(jax.random.key(seed), (B, KEY_LEN), jnp.uint8)
+            state, present = fn(state, keys, lengths)
+            return state, jnp.sum(present.astype(jnp.uint32))
+
+        jit = jax.jit(step, donate_argnums=0)
+        t0 = time.perf_counter()
+        state, carry = jit(state, 0)
+        n0 = int(np.asarray(carry))
+        compile_s = time.perf_counter() - t0
+        # replay fence: same keys again must ALL report present
+        state, carry = jit(state, 0)
+        assert int(np.asarray(carry)) == B, "replay must be fully present"
+        t0 = time.perf_counter()
+        for i in range(1, 1 + STEPS):
+            state, carry = jit(state, i)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({
+            "slack_mult": slack_mult,
+            "geom": {"J": J, "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ},
+            "lambda": lam,
+            "window_fill": round(lam / KJ, 3),
+            "overflow_z_sigma": round(z, 1),
+            "per_batch_overflow_approx": f"{n_windows} windows x "
+                                         f"exp(-z^2/2)={p_tail:.1e}",
+            "first_batch_presence_hits": n0,
+            "ms_per_step": round(dt * 1e3, 2),
+            "fused_keys_per_sec": round(B / dt),
+            "compile_s": round(compile_s, 1),
+        })
+    except Exception as e:  # noqa: BLE001
+        emit({"slack_mult": slack_mult, "error": str(e)[:300]})
+    finally:
+        sweep.choose_fat_params = _orig_choose
+
+
+def main():
+    emit({
+        "shape": f"m=2^32 k=7 blocked512 fat fused, B={B}",
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "timing": f"to-value, {STEPS} chained steps, replay-asserted",
+    })
+    for m in (8, 6, 4):
+        run(m)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

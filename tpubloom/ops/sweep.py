@@ -989,8 +989,9 @@ def choose_fat_params(
 
     J = blocks per 128-lane fat row; R8 = fat rows per placement
     sub-tile; S = sub-tiles per grid step (DMA granularity); KJ = update
-    slots per (substream, sub-tile) window (lambda + 8 sigma, multiple
-    of 8); KBJ = rows per substream big-window fetch. Tiles cap at
+    slots per (substream, sub-tile) window (lambda + slack, multiple of
+    8 — 6 sigma for presence, 8 sigma otherwise; see the loop comment);
+    KBJ = rows per substream big-window fetch. Tiles cap at
     S*R8 = 1024 fat rows; within that, the measured per-kind body/volume
     caps below (r5: presence_geom_r5.json) separate compiling shapes
     from Mosaic scoped-VMEM OOMs."""
@@ -1028,7 +1029,18 @@ def choose_fat_params(
     # candidate, best score first — a smaller R8 may qualify where the
     # score-best one cannot (e.g. tiny filters where P8 // S < 2)
     for _, R8, lam in sorted(candidates):
-        kj_raw = max(16, (lam + max(16, int(8 * math.sqrt(lam))) + 7) // 8 * 8)
+        # window slack: presence windows run 6 sigma (measured r5,
+        # benchmarks/out/kj_slack_r5.json: 41.9M vs 39.8M keys/s at 8
+        # sigma — every slack slot is paid in kernel slot work AND in
+        # the unsort; 4 sigma overflows ~per batch and collapses to the
+        # scatter fallback, 26.1M). Insert/counting keep 8 sigma: their
+        # windows have no unsort side and the risk/benefit was not
+        # re-measured. Overflow is correctness-safe at any slack —
+        # _fat_window_overflow routes the batch to the scatter path.
+        slack = 6 if presence else 8
+        kj_raw = max(
+            16, (lam + max(16, int(slack * math.sqrt(lam))) + 7) // 8 * 8
+        )
         if kj_raw > 1024:
             # a KJ cap at/below mean occupancy would overflow every
             # window and pay the whole sort+stream build only to fall

@@ -48,31 +48,68 @@ def emit(obj):
 _orig_choose = sweep.choose_fat_params
 
 
-def _patched_choose(slack_mult):
+def _patched_choose(slack_mult, kind="presence"):
+    """Override the slack for ONE kernel kind, leaving the shipping
+    slack for the others (presence ships 6 sigma since this probe's
+    first run; insert/counting ship 8)."""
+
     @functools.wraps(_orig_choose)
     def choose(nb, batch, words_per_block=16, *, presence=False,
                counting=False):
         out = _orig_choose(
             nb, batch, words_per_block, presence=presence, counting=counting
         )
-        if out is None or not presence or slack_mult == 8:
+        this_kind = (
+            "presence" if presence else "counting" if counting else "insert"
+        )
+        if out is None or this_kind != kind:
             return out
         J, R8, S, KJ, KBJ = out
         lam = batch * R8 // nb
         kj = max(16, (lam + max(16, int(slack_mult * math.sqrt(lam))) + 7)
                  // 8 * 8)
         kbj = ((lam * S + kj + 64 + 7) // 8) * 8
+        # The override bypasses the chooser's fences (volume caps, VMEM
+        # estimate, non-v5e probe compile), which all ran at the
+        # SHIPPING KJ — re-check them here so a probed slack can't hand
+        # the kernel a shape the chooser would reject (a wider KJ at a
+        # geometry near a cap would otherwise OOM at runtime and be
+        # recorded as an opaque error row).
+        pk = sweep.fat_pack(words_per_block, presence)
+        bodies = S * J * pk
+        volume = bodies * sweep._packed_rows(kj, pk) * R8
+        cap_v = 3_500_000 if presence else 2_200_000 if counting else 4_300_000
+        if presence and bodies > 64:
+            cap_v = 2_200_000
+        sup_rows = sweep._packed_rows(kbj, pk)
+        vmem_ok = (
+            2 * J * sup_rows * 128 * 4 + 4 * (S * R8 * 128 * 4)
+            <= 9 * 1024 * 1024
+        )
+        if kj > 1024 or volume > cap_v or not vmem_ok:
+            raise ValueError(
+                f"slack={slack_mult} pushes geometry out of validated "
+                f"caps (KJ={kj}, volume={volume}, vmem_ok={vmem_ok}) — "
+                f"refusing to probe an un-fenced shape"
+            )
         return J, R8, S, kj, kbj
 
     return choose
 
 
-def run(slack_mult):
-    sweep.choose_fat_params = _patched_choose(slack_mult)
+def run(slack_mult, kind="presence"):
+    sweep.choose_fat_params = _patched_choose(slack_mult, kind)
     try:
-        config = FilterConfig(m=1 << 32, k=7, key_len=KEY_LEN, block_bits=512)
+        counting = kind == "counting"
+        config = FilterConfig(
+            m=1 << (30 if counting else 32), k=7, key_len=KEY_LEN,
+            block_bits=512, counting=counting,
+        )
         nb = config.n_blocks
-        geom = sweep.choose_fat_params(nb, B, 16, presence=True)
+        geom = sweep.choose_fat_params(
+            nb, B, config.words_per_block, presence=kind == "presence",
+            counting=counting,
+        )
         J, R8, S, KJ, KBJ = geom
         lam = B * R8 // nb
         # per-window overflow tail (Poisson upper bound) x window count
@@ -81,31 +118,71 @@ def run(slack_mult):
         # Chernoff/normal tail approx — reported for context, not proof
         p_tail = math.exp(-z * z / 2)
         n_windows = J * (nb // J // R8)
-        fn = make_blocked_test_insert_fn(config, storage_fat=True)
-        assert blocked_storage_fat(config)
         lengths = jnp.full((B,), KEY_LEN, jnp.int32)
-        fat_rows = nb * 16 // 128
+        fat_rows = nb * config.words_per_block // 128
         state = jnp.zeros((fat_rows, 128), jnp.uint32)
 
-        def step(state, seed):
-            keys = jax.random.bits(jax.random.key(seed), (B, KEY_LEN), jnp.uint8)
-            state, present = fn(state, keys, lengths)
-            return state, jnp.sum(present.astype(jnp.uint32))
+        if kind == "presence":
+            fn = make_blocked_test_insert_fn(config, storage_fat=True)
+            assert blocked_storage_fat(config)
+
+            def step(state, seed):
+                keys = jax.random.bits(
+                    jax.random.key(seed), (B, KEY_LEN), jnp.uint8
+                )
+                state, present = fn(state, keys, lengths)
+                return state, jnp.sum(present.astype(jnp.uint32))
+        elif kind == "insert":
+            from tpubloom.filter import make_blocked_insert_fn
+
+            ins = make_blocked_insert_fn(config, storage_fat=True)
+
+            def step(state, seed):
+                keys = jax.random.bits(
+                    jax.random.key(seed), (B, KEY_LEN), jnp.uint8
+                )
+                state = ins(state, keys, lengths)
+                return state, jnp.sum(
+                    state[:: max(1, state.shape[0] // 64)], dtype=jnp.uint32
+                )
+        else:  # counting: alternating insert/delete, as counting_rate.py
+            from tpubloom.filter import make_blocked_counter_fn
+
+            ins = make_blocked_counter_fn(
+                config, increment=True, storage_fat=True
+            )
+            dele = make_blocked_counter_fn(
+                config, increment=False, storage_fat=True
+            )
+
+            def step(state, seed):
+                keys = jax.random.bits(
+                    jax.random.key(seed // 2), (B, KEY_LEN), jnp.uint8
+                )
+                state = jax.lax.cond(
+                    seed % 2 == 0,
+                    lambda s: ins(s, keys, lengths),
+                    lambda s: dele(s, keys, lengths),
+                    state,
+                )
+                return state, jnp.sum(state[0], dtype=jnp.uint32)
 
         jit = jax.jit(step, donate_argnums=0)
         t0 = time.perf_counter()
         state, carry = jit(state, 0)
         n0 = int(np.asarray(carry))
         compile_s = time.perf_counter() - t0
-        # replay fence: same keys again must ALL report present
-        state, carry = jit(state, 0)
-        assert int(np.asarray(carry)) == B, "replay must be fully present"
+        if kind == "presence":
+            # replay fence: same keys again must ALL report present
+            state, carry = jit(state, 0)
+            assert int(np.asarray(carry)) == B, "replay must be fully present"
         t0 = time.perf_counter()
         for i in range(1, 1 + STEPS):
             state, carry = jit(state, i)
         int(np.asarray(carry))
         dt = (time.perf_counter() - t0) / STEPS
-        emit({
+        row = {
+            "kind": kind,
             "slack_mult": slack_mult,
             "geom": {"J": J, "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ},
             "lambda": lam,
@@ -113,28 +190,43 @@ def run(slack_mult):
             "overflow_z_sigma": round(z, 1),
             "per_batch_overflow_approx": f"{n_windows} windows x "
                                          f"exp(-z^2/2)={p_tail:.1e}",
-            "first_batch_presence_hits": n0,
             "ms_per_step": round(dt * 1e3, 2),
-            "fused_keys_per_sec": round(B / dt),
             "compile_s": round(compile_s, 1),
-        })
+        }
+        if kind == "presence":
+            # field names match the original presence rows in the
+            # artifact (append mode must not mix schemas)
+            row["first_batch_presence_hits"] = n0
+            row["fused_keys_per_sec"] = round(B / dt)
+        else:
+            row["first_batch_carry"] = n0
+            row["keys_per_sec"] = round(B / dt)
+        emit(row)
     except Exception as e:  # noqa: BLE001
-        emit({"slack_mult": slack_mult, "error": str(e)[:300]})
+        emit({"kind": kind, "slack_mult": slack_mult, "error": str(e)[:300]})
     finally:
         sweep.choose_fat_params = _orig_choose
 
 
 def main():
+    import sys
+
+    kinds = sys.argv[1:] or ["presence"]
+    timing = f"to-value, {STEPS} chained steps"
+    if "presence" in kinds:
+        timing += "; presence replay-asserted"
     emit({
-        "shape": f"m=2^32 k=7 blocked512 fat fused, B={B}",
+        "shape": f"m=2^32 (2^30 counting) k=7 blocked512 fat, B={B}",
+        "kinds": kinds,
         "platform": jax.default_backend(),
         "device": str(jax.devices()[0]),
-        "timing": f"to-value, {STEPS} chained steps, replay-asserted",
+        "timing": timing,
     })
-    for m in (8, 6, 4):
-        run(m)
+    for kind in kinds:
+        for m in (8, 6, 4) if kind == "presence" else (8, 6):
+            run(m, kind)
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
-    with open(OUT_PATH, "w") as f:
+    with open(OUT_PATH, "a") as f:
         for r in _rows:
             f.write(json.dumps(r) + "\n")
 

@@ -1033,9 +1033,10 @@ def choose_fat_params(
         # benchmarks/out/kj_slack_r5.json: 41.9M vs 39.8M keys/s at 8
         # sigma — every slack slot is paid in kernel slot work AND in
         # the unsort; 4 sigma overflows ~per batch and collapses to the
-        # scatter fallback, 26.1M). Insert/counting keep 8 sigma: their
-        # windows have no unsort side and the risk/benefit was not
-        # re-measured. Overflow is correctness-safe at any slack —
+        # scatter fallback, 26.1M). Insert keeps 8 sigma: 6 sigma was
+        # re-measured a wash (67.2M vs 67.8M, same artifact — no unsort
+        # side, slimmer windows). Counting keeps 8 sigma untested.
+        # Overflow is correctness-safe at any slack —
         # _fat_window_overflow routes the batch to the scatter path.
         slack = 6 if presence else 8
         kj_raw = max(
@@ -1077,7 +1078,13 @@ def choose_fat_params(
             #   only fences untested corners.
             pk = fat_pack(w, presence)
             bodies = s * J * pk
-            if bodies > (128 if presence else 256):
+            # bodies bound per kernel kind: insert validated at 256
+            # bodies (B=8M, (128, 8) — ran at 67.8M keys/s r5);
+            # counting OOMs at 256 bodies even at 2.10M volume (B=8M
+            # probe, r5 — its nibble plane expansions out-stack the
+            # insert kernel at equal geometry) and is validated at 128;
+            # presence validated at 128.
+            if bodies > (256 if not (presence or counting) else 128):
                 continue
             volume = bodies * _packed_rows(KJ, pk) * R8
             cap_v = (

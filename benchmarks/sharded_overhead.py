@@ -10,7 +10,7 @@ Compares, on the ONE real chip, the same total work:
 Any difference is the sharded machinery's cost: the routing murmur pass,
 the owned-mask plumbing, shard_map tracing overhead, and the psum (a
 no-op collective on a 1-device mesh). Device-generated keys, to-value
-timing. Writes benchmarks/out/sharded_overhead_r4.json.
+timing. Writes benchmarks/out/sharded_overhead_r5.json.
 """
 
 from __future__ import annotations
@@ -32,7 +32,7 @@ B = 1 << 22
 KEY_LEN = 16
 STEPS = 8
 OUT_PATH = os.path.join(
-    os.path.dirname(__file__), "out", "sharded_overhead_r4.json"
+    os.path.dirname(__file__), "out", "sharded_overhead_r5.json"
 )
 _rows = []
 

@@ -52,7 +52,7 @@ from tpubloom.ops.sweep import (
 )
 
 LOG2M = 32
-B = 1 << 22
+B = 1 << 23 if "--b8m" in sys.argv else 1 << 22  # --b8m: shipping batch
 KEY_LEN = 16
 STEPS = 16
 PRESENCE = "--insert-only" not in sys.argv
@@ -68,7 +68,11 @@ P8 = NBJ // R8
 FAT_SHAPE = (NB * W // 128, 128)
 lengths = jnp.full((B,), KEY_LEN, jnp.int32)
 
-OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "profile_fat_r5.json")
+OUT_PATH = os.path.join(
+    os.path.dirname(__file__), "out",
+    "profile_fat_b8m_r5.json" if "--b8m" in sys.argv
+    else "profile_fat_r5.json",
+)
 _rows = []
 
 

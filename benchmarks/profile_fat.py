@@ -20,8 +20,9 @@ Stages (cumulative prefixes; deltas reported at the end):
   P6 full         apply_fat_updates (+ presence unsort + overflow cond)
 
 Also: kernel-only on a prebuilt stream, and lax.sort operand scaling.
-Run: timeout 1800 python benchmarks/profile_fat.py [--insert-only]
-Writes benchmarks/out/profile_fat_r4.json (one JSON object per line).
+Run: timeout 2400 python -m benchmarks.profile_fat [--insert-only] [--b8m]
+Writes benchmarks/out/profile_fat_r5.json — or profile_fat_b8m_r5.json
+with --b8m (B=8M, the shipping bench batch) — one JSON object per line.
 """
 
 from __future__ import annotations
@@ -52,7 +53,8 @@ from tpubloom.ops.sweep import (
 )
 
 LOG2M = 32
-B = 1 << 23 if "--b8m" in sys.argv else 1 << 22  # --b8m: shipping batch
+B8M = "--b8m" in sys.argv  # shipping bench batch; drives B AND the out path
+B = 1 << 23 if B8M else 1 << 22
 KEY_LEN = 16
 STEPS = 16
 PRESENCE = "--insert-only" not in sys.argv
@@ -70,8 +72,7 @@ lengths = jnp.full((B,), KEY_LEN, jnp.int32)
 
 OUT_PATH = os.path.join(
     os.path.dirname(__file__), "out",
-    "profile_fat_b8m_r5.json" if "--b8m" in sys.argv
-    else "profile_fat_r5.json",
+    "profile_fat_b8m_r5.json" if B8M else "profile_fat_r5.json",
 )
 _rows = []
 

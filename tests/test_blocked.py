@@ -261,3 +261,23 @@ def test_identity_mismatch_treats_missing_block_bits_as_flat():
     assert identity_mismatch(a, legacy) is None
     b = FilterConfig(m=1 << 16, k=7, block_bits=512)
     assert identity_mismatch(b, legacy) == "block_bits"
+
+
+def test_replicate_masks_128_matches_lane_concat():
+    """The matmul lane replication (byte-quarter matmuls against a
+    constant 0/1 weight) must be bit-exact with the concat reference it
+    replaced — the concat is a ~47 ms relayout on TPU
+    (benchmarks/out/query_fix_r5.json), but on CPU it is the obvious
+    ground truth."""
+    import jax.numpy as jnp
+
+    from tpubloom.ops.blocked import _replicate_masks_128
+
+    rng = np.random.default_rng(42)
+    for w in (8, 16, 32):
+        J = 128 // w
+        masks = rng.integers(0, 1 << 32, size=(257, w), dtype=np.uint64)
+        masks = masks.astype(np.uint32)
+        got = np.asarray(_replicate_masks_128(jnp.asarray(masks)))
+        expected = np.concatenate([masks] * J, axis=1)
+        np.testing.assert_array_equal(got, expected)

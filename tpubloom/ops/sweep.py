@@ -902,14 +902,41 @@ def apply_blocked_updates(
 # path (same blocked position spec).
 
 
-# Device generations whose fat-kernel operand-volume caps below are
-# hardware-measured (benchmarks/out/presence_geom_r5.json,
-# adversarial_r5.json). On any OTHER TPU generation the scoped-VMEM
-# limits may differ, so a geometry inside the caps is probe-compiled
-# once (AOT, cached) before being returned — unvalidated parts degrade
-# to the legacy/scatter path instead of erroring at first use.
+# Device generations whose fat-kernel caps below are hardware-measured
+# (benchmarks/out/presence_geom_r5.json, adversarial_r5.json,
+# geom8m_r5.json). On any OTHER TPU generation every geometry is
+# probe-compiled; on v5e itself, presence/counting geometries OUTSIDE
+# the validated set below are probed too — round 5 measured that
+# Mosaic's scoped-VMEM acceptance is NOT a clean function of the
+# (bodies, volume) caps ((256,2,KJP=176) fails at 2.88M "volume" while
+# (512,2,KJP=96) passes at 3.15M), so the caps prune the search and
+# the probe is the ground truth for unlisted corners. A failed probe
+# demotes to the next candidate shape / scatter path instead of
+# erroring at first use.
 _VALIDATED_DEVICE_KINDS = ("TPU v5 lite",)
 _GEOM_PROBE_CACHE: dict = {}
+# (J, R8, S, KJP) tuples that compiled AND ran bit-exact on v5e
+# hardware this round (adversarial_r5.json, presence_geom_r5.json,
+# kj_slack_r5.json, geom8m_r5.json, bench/b_sweep runs).
+_VALIDATED_GEOMS = {
+    "presence": {
+        (8, 512, 2, 96),    # B=4M shipping (KJ=352)
+        (8, 512, 2, 104),   # B=4M/8M at 8-sigma (KJ=384)
+        (8, 256, 2, 96),    # B=8M 6-sigma (KJ=352)
+        (8, 256, 2, 104),   # B=8M 8-sigma (KJ=384)
+        (8, 512, 1, 176),   # B=8M lambda=512 (KJ=648)
+        (8, 1024, 1, 64),   # B=1M lambda=128 at R8=1024 (KJ=200)
+        (8, 256, 4, 64),    # presence_geom (KJ=224)
+        (8, 128, 4, 96),    # m=2^28 adversarial (KJ=352)
+        (8, 128, 4, 64),    # small-filter corners (KJ<=224)
+        (16, 512, 1, 64),   # bb=256 adversarial (KJ=200)
+        (4, 256, 4, 352),   # bb=1024 pack=1 adversarial (KJ=352)
+    },
+    "counting": {
+        (8, 256, 4, 64),    # config-4 B=4M (KJ=224)
+        (8, 128, 4, 64),    # B=8M post-fix (73.2M ops/s)
+    },
+}
 
 
 def _fat_geometry_compiles(
@@ -917,22 +944,28 @@ def _fat_geometry_compiles(
 ) -> bool:
     """True if the fat kernel at ``geom`` compiles on the current device.
 
-    v5e ("TPU v5 lite") skips the probe — the caps in
-    :func:`choose_fat_params` are measured there. Elsewhere the chosen
-    kernel is lowered + compiled AOT against ShapeDtypeStructs (no
-    operand allocation) in a try/except, one compile per geometry per
-    process. CPU/GPU backends return True unchanged: the sweep path is
-    never auto-selected off-TPU, and tests drive the kernel in
-    interpret mode where Mosaic limits don't apply."""
+    On v5e, insert geometries inside the caps always pass (no insert
+    OOM was ever measured inside them), and presence/counting
+    geometries pass if listed in ``_VALIDATED_GEOMS``; anything else —
+    and everything on other TPU generations — is lowered + compiled AOT
+    against ShapeDtypeStructs (no operand allocation) in a try/except,
+    one compile per geometry per process. CPU/GPU backends return True
+    unchanged: the sweep path is never auto-selected off-TPU, and tests
+    drive the kernel in interpret mode where Mosaic limits don't
+    apply."""
     try:
         if jax.default_backend() != "tpu":
             return True
         kind = jax.devices()[0].device_kind
     except Exception:
         return True
-    if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
-        return True
     J, R8, S, KJ, KBJ = geom
+    if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
+        if not (presence or counting):
+            return True
+        sig = (J, R8, S, _packed_rows(KJ, fat_pack(w, presence)))
+        if sig in _VALIDATED_GEOMS["presence" if presence else "counting"]:
+            return True
     key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting)
     hit = _GEOM_PROBE_CACHE.get(key)
     if hit is not None:
@@ -1010,12 +1043,17 @@ def choose_fat_params(
         return None
     NBJ = nb // J
     cap = 1024
-    # lambda target: the kernel is per-window-overhead-bound, not
-    # MAC-bound, so presence prefers lambda ~ 256 (R8=512 at the
-    # north-star shape): measured 66.2 ms vs 74.0 ms for lambda ~ 128
-    # (benchmarks/out/presence_geom_r5.json). Insert-only/counting keep
-    # the r4-validated lambda ~ 128 target.
-    lam_target = 8 if presence else 7
+    # lambda preference: the kernel is per-window-overhead-bound, not
+    # MAC-bound, so PRESENCE takes the LARGEST feasible lambda — every
+    # doubling halves the per-batch window count, and the measured
+    # curve is monotone across the whole feasible range: lambda 128
+    # (102.1 ms) -> 256 (66.2) at B=4M (presence_geom_r5.json), 256
+    # (41.6M keys/s) -> 512 (44.0M) at B=8M (geom8m_r5.json). The
+    # volume/KJ caps bound lambda from above (R8=1024 at B=4M and
+    # lambda=1024 at B=16M are both cap-excluded), so "largest
+    # feasible" stays inside the hardware-validated envelope.
+    # Insert-only/counting keep the r4-validated lambda ~ 128 target.
+    lam_target = 7
     candidates = []
     for r8 in (32, 64, 128, 256, 512, 1024):
         if r8 > NBJ or NBJ % r8:
@@ -1023,7 +1061,7 @@ def choose_fat_params(
         lam = batch * r8 // nb
         if lam < 8:
             continue
-        score = abs(math.log2(max(lam, 1)) - lam_target)
+        score = -lam if presence else abs(math.log2(max(lam, 1)) - lam_target)
         candidates.append((score, r8, lam))
     # feasibility (grid depth, lane columns, VMEM) is checked per
     # candidate, best score first — a smaller R8 may qualify where the

@@ -83,6 +83,12 @@ def config2(scale: float, layout: str = "flat") -> dict:
     else:
         cfg = FilterConfig(m=1 << log2m, k=10, key_len=16)
         f = BloomFilter(cfg)
+    # B=1M, measured optimum at THIS shape (r5): the m=2^30 array is 8x
+    # smaller than the north-star's, so whole-array-stream amortization
+    # saturates by B=1M and larger batches only pay the sorts'
+    # super-linear growth — B=8M measured 45.3M insert / 27.2M query
+    # vs 60.7M / 33.1M at B=1M (config2_r5.json keeps the B=1M run).
+    # The north-star m=2^32 shape is the opposite (b_sweep_r5.json).
     B = min(1 << 20, max(1 << 12, n // 8))
     # the whole insert stream runs inside ONE jit (lax.fori_loop over
     # device-generated batches): per-batch eager dispatch through the

@@ -2,10 +2,13 @@
 """Config-3 streaming at scale (VERDICT r2 #6).
 
 Part 1 — device-generated stream with checkpoints: insert >= 100M
-device-generated keys into an m=2^30 blocked filter in 4M-key fused
-steps, once without checkpoints and once with the AsyncCheckpointer
-triggering every 32M keys (double-buffered HBM snapshot + async D2H +
-background sink write). Reports the checkpoint-induced STALL on the
+device-generated keys into an m=2^30 blocked filter in B-key fused
+steps (``--batch-log2``, default 4M — the value every r2-r5 artifact
+row was measured at; pass 23 for the r5 bench-optimum 8M, which the
+m=2^34 52.0M row in streaming_r5.json used), once without checkpoints
+and once with the AsyncCheckpointer triggering every
+``--ckpt-every-steps * B`` keys (default 8 steps; double-buffered HBM
+snapshot + async D2H + background sink write). Reports the checkpoint-induced STALL on the
 insert loop (the D2H itself rides the transfer engine and the writes a
 background thread; only the HBM copy + scheduling contention can stall
 inserts). Target: < 5%.
@@ -41,6 +44,7 @@ _ap.add_argument("--log2m", type=int, default=30)
 _ap.add_argument("--total-mkeys", type=int, default=128)
 _ap.add_argument("--ckpt-every-steps", type=int, default=8)
 _ap.add_argument("--skip-host-fed", action="store_true")
+_ap.add_argument("--batch-log2", type=int, default=22, help="device batch size (2^N keys); default 4M reproduces the r2-r5 artifact rows, 23 (=8M) is the r5 bench optimum")
 _ap.add_argument(
     "--no-ckpt-only", action="store_true",
     help="run only the no-checkpoint device stream (the m=2^34 spec "
@@ -51,9 +55,9 @@ _ap.add_argument(
 _ARGS = _ap.parse_args()
 
 LOG2M = _ARGS.log2m
-B = 1 << 22
+B = 1 << _ARGS.batch_log2
 TOTAL = _ARGS.total_mkeys * (1 << 20)
-CKPT_EVERY_STEPS = _ARGS.ckpt_every_steps  # default 8 * 4M = 32M keys
+CKPT_EVERY_STEPS = _ARGS.ckpt_every_steps  # default 8 steps = 8 * B keys
 
 config = FilterConfig(
     m=1 << LOG2M, k=7, key_len=16, block_bits=512, key_name="stream-bench"

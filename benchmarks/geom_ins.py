@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Insert/counting lambda sweep at B=8M (round 5 follow-up to geom8m).
+
+The presence kernel measured monotone-in-lambda across its feasible
+range (geom8m_r5.json); insert and counting still target lambda~128.
+Feasible-under-caps candidates at B=8M, m=2^32 (counting m=2^30):
+
+  insert:   (128, 8, KJ=224) lam=128 [shipping], (256, 4, KJ=384)
+            lam=256, (512, 1, KJ=648) lam=512
+  counting: (128, 4, KJ=224) lam=128 [shipping], (256, 2, KJ=384)
+            lam=256   ((512, 1) is cap-excluded at 2.88M volume)
+
+Each geometry is FORCED explicitly (reproducible under any future
+chooser), run to-value over 8 chained steps; counting alternates
+insert/delete so counters stay bounded. Writes
+benchmarks/out/geom_ins_r5.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubloom.config import FilterConfig
+from tpubloom.ops import sweep
+
+B = 1 << 23
+KEY_LEN = 16
+STEPS = 8
+OUT_PATH = os.path.join(os.path.dirname(__file__), "out", "geom_ins_r5.json")
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+_orig_choose = sweep.choose_fat_params
+
+
+def _force(kind, geom):
+    @functools.wraps(_orig_choose)
+    def choose(nb, batch, words_per_block=16, *, presence=False,
+               counting=False):
+        this = "presence" if presence else "counting" if counting else "insert"
+        if this == kind and geom is not None:
+            return geom
+        return _orig_choose(
+            nb, batch, words_per_block, presence=presence, counting=counting
+        )
+
+    return choose
+
+
+def run_insert(tag, geom):
+    from tpubloom.filter import make_blocked_insert_fn
+
+    sweep.choose_fat_params = _force("insert", geom)
+    try:
+        config = FilterConfig(m=1 << 32, k=7, key_len=KEY_LEN, block_bits=512)
+        ins = make_blocked_insert_fn(config, storage_fat=True)
+        lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+        state = jnp.zeros((config.n_blocks * 16 // 128, 128), jnp.uint32)
+
+        def step(state, seed):
+            keys = jax.random.bits(jax.random.key(seed), (B, KEY_LEN), jnp.uint8)
+            state = ins(state, keys, lengths)
+            return state, jnp.sum(
+                state[:: max(1, state.shape[0] // 64)], dtype=jnp.uint32
+            )
+
+        jit = jax.jit(step, donate_argnums=0)
+        t0 = time.perf_counter()
+        state, carry = jit(state, 0)
+        int(np.asarray(carry))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(1, 1 + STEPS):
+            state, carry = jit(state, i)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({"kind": "insert", "variant": tag, "geom": list(geom) if geom
+              else None, "ms_per_step": round(dt * 1e3, 2),
+              "keys_per_sec": round(B / dt), "compile_s": round(compile_s, 1)})
+    except Exception as e:  # noqa: BLE001
+        emit({"kind": "insert", "variant": tag, "error": str(e)[:300]})
+    finally:
+        sweep.choose_fat_params = _orig_choose
+
+
+def run_counting(tag, geom):
+    from tpubloom.filter import blocked_device_shape, make_blocked_counter_fn
+
+    sweep.choose_fat_params = _force("counting", geom)
+    try:
+        config = FilterConfig(
+            m=1 << 30, k=7, key_len=KEY_LEN, counting=True, block_bits=512
+        )
+        ins = make_blocked_counter_fn(config, increment=True, storage_fat=True)
+        dele = make_blocked_counter_fn(
+            config, increment=False, storage_fat=True
+        )
+        lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+        def step(state, carry, i):
+            keys = jax.random.bits(jax.random.key(i // 2), (B, KEY_LEN),
+                                   jnp.uint8)
+            state = jax.lax.cond(
+                i % 2 == 0,
+                lambda s: ins(s, keys, lengths),
+                lambda s: dele(s, keys, lengths),
+                state,
+            )
+            return state, carry ^ jnp.sum(state[0], dtype=jnp.uint32)
+
+        jit = jax.jit(step, donate_argnums=0)
+        state = jnp.zeros(blocked_device_shape(config), jnp.uint32)
+        t0 = time.perf_counter()
+        state, carry = jit(state, jnp.uint32(0), 0)
+        int(np.asarray(carry))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(1, 1 + STEPS):
+            state, carry = jit(state, carry, i)
+        int(np.asarray(carry))
+        dt = (time.perf_counter() - t0) / STEPS
+        emit({"kind": "counting", "variant": tag, "geom": list(geom) if geom
+              else None, "ms_per_step": round(dt * 1e3, 2),
+              "ops_per_sec": round(B / dt), "compile_s": round(compile_s, 1)})
+    except Exception as e:  # noqa: BLE001
+        emit({"kind": "counting", "variant": tag, "error": str(e)[:300]})
+    finally:
+        sweep.choose_fat_params = _orig_choose
+
+
+def main():
+    emit({
+        "shape": f"insert m=2^32 / counting m=2^30, k=7, blocked512 fat, B={B}",
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "timing": f"to-value, {STEPS} chained steps",
+    })
+    run_insert("lam=128 shipping (128,8,224)", (8, 128, 8, 224, 1312))
+    run_insert("lam=256 (256,4,384)", (8, 256, 4, 384, 1472))
+    run_insert("lam=512 (512,1,648)", (8, 512, 1, 648, 1224))
+    run_counting("lam=128 shipping (128,4,224)", (8, 128, 4, 224, 800))
+    run_counting("lam=256 (256,2,384)", (8, 256, 2, 384, 960))
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

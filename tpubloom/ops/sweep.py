@@ -934,7 +934,8 @@ _VALIDATED_GEOMS = {
     },
     "counting": {
         (8, 256, 4, 64),    # config-4 B=4M (KJ=224)
-        (8, 128, 4, 64),    # B=8M post-fix (73.2M ops/s)
+        (8, 128, 4, 64),    # B=8M lambda=128 (73.2M ops/s)
+        (8, 256, 2, 104),   # B=8M lambda=256 (74.0M — geom_ins_r5.json)
     },
 }
 
@@ -1052,7 +1053,17 @@ def choose_fat_params(
     # volume/KJ caps bound lambda from above (R8=1024 at B=4M and
     # lambda=1024 at B=16M are both cap-excluded), so "largest
     # feasible" stays inside the hardware-validated envelope.
-    # Insert-only/counting keep the r4-validated lambda ~ 128 target.
+    # Insert-only/counting keep lambda ~ 128: their lambda-optimum is
+    # SHAPE-DEPENDENT and 128 is the only universally-safe point
+    # measured. geom_ins_r5.json (B=8M, m=2^32): lambda=256 via R8=256
+    # is +3.6% insert / +2.7% counting and flat at 512 — but the same
+    # lambda=256 target at m=2^34 forces R8=1024 (4x placement MACs/
+    # key) and measured -12% (45.5M vs 52.0M — both rows in
+    # streaming_r5.json), so a
+    # global target of 256 regresses the config-3 spec point. A
+    # per-(nb, B) tuned table is possible future work; presence is
+    # different (largest-feasible, measured monotone at every shape
+    # tried) because halved window count dominates its MAC growth.
     lam_target = 7
     candidates = []
     for r8 in (32, 64, 128, 256, 512, 1024):

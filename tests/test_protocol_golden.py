@@ -57,6 +57,8 @@ GOLDEN = {
     "Clear": ("Clear", "81a46e616d65a6676f6c64656e"),
     "ListFilters": ("ListFilters", "80"),
     "DropFilter": ("DropFilter", "81a46e616d65aa676f6c64656e2d636e74"),
+    "SlowlogGet": ("SlowlogGet", "81a16e0a"),
+    "SlowlogReset": ("SlowlogReset", "80"),
 }
 
 #: the dict each fixture encodes (the pin below keeps python<->ruby
@@ -81,6 +83,8 @@ GOLDEN_DICTS = {
     "Clear": {"name": "golden"},
     "ListFilters": {},
     "DropFilter": {"name": "golden-cnt"},
+    "SlowlogGet": {"n": 10},
+    "SlowlogReset": {},
 }
 
 
@@ -167,6 +171,17 @@ def test_golden_replay_against_live_server(raw_server):
     assert _call(ch, *GOLDEN["DropFilter"])["ok"]
     r = _call(ch, *GOLDEN["ListFilters"])
     assert r["filters"] == ["golden"]
+
+    # slowlog parity RPCs: every request above was recorded (no rid in
+    # the raw golden bytes -> the server generated one per request)
+    r = _call(ch, *GOLDEN["SlowlogGet"])
+    assert r["ok"] and len(r["entries"]) > 0
+    e = r["entries"][0]
+    assert {"id", "time", "method", "rid", "duration_s", "batch", "args",
+            "phases"} <= set(e)
+    assert e["method"] in protocol.METHODS and e["rid"]
+    r = _call(ch, *GOLDEN["SlowlogReset"])
+    assert r["ok"] and r["cleared"] > 0
 
     # error shape the Ruby driver's rpc_once parses
     bad = msgpack.packb({"name": "missing-filter", "keys": [b"x"]},

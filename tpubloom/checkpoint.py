@@ -434,6 +434,10 @@ class AsyncCheckpointer:
         self._stop = False
         self.last_error: Optional[Exception] = None
         self.checkpoints_written = 0
+        #: observability (the /metrics checkpoint gauges): when the last
+        #: checkpoint landed in the sink + how long its write took
+        self.last_checkpoint_time: Optional[float] = None
+        self.last_checkpoint_duration_s: Optional[float] = None
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -443,10 +447,13 @@ class AsyncCheckpointer:
             if item is None:
                 return
             seq, key_name, blob_fn = item
+            t0 = time.perf_counter()
             try:
                 # blob_fn blocks until the async D2H copies land.
                 self.sink.put(key_name, seq, blob_fn())
                 self.checkpoints_written += 1
+                self.last_checkpoint_time = time.time()
+                self.last_checkpoint_duration_s = time.perf_counter() - t0
                 self.last_error = None  # a success clears a transient failure
             except Exception as e:  # surfaced via last_error + health checks
                 self.last_error = e
@@ -456,8 +463,27 @@ class AsyncCheckpointer:
     def notify_inserts(self, n: int) -> None:
         self._since_last += n
         if self.every_n_inserts and self._since_last >= self.every_n_inserts:
-            if self.trigger():
-                self._since_last = 0
+            self.trigger()  # resets _since_last itself when it fires
+
+    def obs_stats(self) -> dict:
+        """Checkpoint gauges for /metrics and the per-filter Stats RPC:
+        lag (inserts since the last trigger fired), age (seconds since a
+        write last landed), last write duration, seq, written count."""
+        return {
+            "lag_inserts": self._since_last,
+            "age_seconds": (
+                time.time() - self.last_checkpoint_time
+                if self.last_checkpoint_time is not None
+                else None
+            ),
+            "last_duration_seconds": self.last_checkpoint_duration_s,
+            "seq": self._seq,
+            "checkpoints_written": self.checkpoints_written,
+            "in_flight": self._busy.is_set(),
+            "last_error": (
+                repr(self.last_error) if self.last_error is not None else None
+            ),
+        }
 
     def trigger(self) -> bool:
         """Start an async checkpoint now; False if one is still in flight.
@@ -469,6 +495,9 @@ class AsyncCheckpointer:
             if self._stop or self._busy.is_set():
                 return False
             self._busy.set()
+            # a landed trigger restarts the lag window — manual triggers
+            # (Checkpoint RPC) count too, or the lag gauge would lie
+            self._since_last = 0
             self._seq = max(self._seq + 1, int(time.time() * 1000))
             extra = _usage_extra(self.filter)
             if self.meta_fn:

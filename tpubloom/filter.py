@@ -20,6 +20,7 @@ TPU-first mechanics:
 
 from __future__ import annotations
 
+import math
 from typing import Sequence
 
 import jax
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpubloom.config import FilterConfig
+from tpubloom.obs import context as obs
 from tpubloom.ops import bitops, blocked, counting, hashing
 from tpubloom.utils.packing import (
     pack_keys,
@@ -321,15 +323,24 @@ class _FilterBase:
         self.words = jnp.zeros((n_storage_words,), jnp.uint32)
 
     def _pack_padded(self, keys: Sequence[bytes | str]):
-        keys_u8, lengths = pack_keys(
-            keys, self.config.key_len, key_policy=self.config.key_policy
-        )
-        B = len(keys)
-        Bp = _pad_to_bucket(B)
-        if Bp != B:
-            keys_u8 = np.pad(keys_u8, ((0, Bp - B), (0, 0)))
-            lengths = np.pad(lengths, (0, Bp - B), constant_values=-1)
+        # obs.phase spans are no-ops outside an active request context
+        # (the gRPC server / bench open one) — see tpubloom.obs.context
+        with obs.phase("host_prep"):
+            keys_u8, lengths = pack_keys(
+                keys, self.config.key_len, key_policy=self.config.key_policy
+            )
+            B = len(keys)
+            Bp = _pad_to_bucket(B)
+            if Bp != B:
+                keys_u8 = np.pad(keys_u8, ((0, Bp - B), (0, 0)))
+                lengths = np.pad(lengths, (0, Bp - B), constant_values=-1)
         return keys_u8, lengths, B
+
+    def _stage_batch(self, keys_u8, lengths):
+        """H2D staging under its own phase span, so the breakdown
+        separates transfer-bound from kernel-bound time server-side."""
+        with obs.phase("h2d"):
+            return jnp.asarray(keys_u8), jnp.asarray(lengths)
 
     def block_until_ready(self) -> None:
         self.words.block_until_ready()
@@ -358,12 +369,29 @@ class _FilterBase:
 
     def insert_batch(self, keys: Sequence[bytes | str]) -> None:
         keys_u8, lengths, B = self._pack_padded(keys)
-        self.words = self._insert(self.words, keys_u8, lengths)
+        keys_u8, lengths = self._stage_batch(keys_u8, lengths)
+        with obs.phase("kernel"):
+            self.words = self._insert(self.words, keys_u8, lengths)
+            if obs.current() is not None:
+                # fence so the kernel phase covers real device work, not
+                # just async dispatch; only under an active request (the
+                # library/streaming path keeps JAX's async pipelining).
+                # Cost on the server path is negligible: the per-filter
+                # op lock + donation data dependence already serialize
+                # same-filter work, and the gRPC hop is transport-bound
+                # at ~1/50 of device rate (benchmarks grpc_path_r5)
+                self.words.block_until_ready()
         self.n_inserted += B
 
     def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
         keys_u8, lengths, B = self._pack_padded(keys)
-        out = np.asarray(self._query(self.words, keys_u8, lengths))
+        keys_u8, lengths = self._stage_batch(keys_u8, lengths)
+        with obs.phase("kernel"):
+            hits = self._query(self.words, keys_u8, lengths)
+            if obs.current() is not None:
+                hits.block_until_ready()
+        with obs.phase("d2h"):
+            out = np.asarray(hits)
         self.n_queried += B
         return out[:B]
 
@@ -390,7 +418,8 @@ class _FilterBase:
 
     __contains__ = include
 
-    # observability (SURVEY.md §5 metrics: fill ratio & predicted FPR)
+    # observability (SURVEY.md §5 metrics: fill ratio & predicted FPR;
+    # the /metrics gauges in tpubloom.obs.exposition read these)
 
     def fill_ratio(self) -> float:
         if self.config.counting:
@@ -399,6 +428,43 @@ class _FilterBase:
 
     def estimated_fpr(self) -> float:
         return self.fill_ratio() ** self.config.k
+
+    def predicted_fpr(self) -> float:
+        """Analytic FPR from the geometry and ``n_inserted`` alone:
+        ``(1 - e^{-kn/m})^k``. Contrast with :meth:`estimated_fpr`
+        (computed from the OBSERVED fill) — the gap between them is the
+        ``fpr_drift`` gauge: sustained drift means the deployed key
+        distribution (duplicates, adversarial keys) or a kernel
+        regression is violating the sizing model the filter was
+        provisioned with."""
+        m, k = self.config.m, self.config.k
+        if self.config.block_bits:
+            # the blocked layout's own (measurement-pinned) model — using
+            # the flat formula here would misread the layout's inherent
+            # FPR excess at high fill as deployment drift
+            from tpubloom.params import blocked_fpr
+
+            return blocked_fpr(
+                self.n_inserted,
+                m=m,
+                k=k,
+                block_bits=self.config.block_bits,
+                block_hash=self.config.block_hash,
+            )
+        return (1.0 - math.exp(-k * self.n_inserted / m)) ** k
+
+    def _fpr_gauges(self) -> dict:
+        """fill/bits/FPR gauge block shared by the non-counting stats()."""
+        fill = self.fill_ratio()
+        estimated = fill**self.config.k
+        predicted = self.predicted_fpr()
+        return {
+            "fill_ratio": fill,
+            "bits_set": int(round(fill * self.config.m)),
+            "estimated_fpr": estimated,
+            "predicted_fpr": predicted,
+            "fpr_drift": estimated - predicted,
+        }
 
 
 class BloomFilter(_FilterBase):
@@ -417,8 +483,7 @@ class BloomFilter(_FilterBase):
             "k": self.config.k,
             "n_inserted": self.n_inserted,
             "n_queried": self.n_queried,
-            "fill_ratio": self.fill_ratio(),
-            "estimated_fpr": self.estimated_fpr(),
+            **self._fpr_gauges(),
         }
 
     # persistence (Redis-string-bitmap format, reference-compatible)
@@ -498,9 +563,15 @@ class BlockedBloomFilter(_FilterBase):
                 donate_argnums=0,
             )
         keys_u8, lengths, B = self._pack_padded(keys)
-        self.words, present = self._test_insert(self.words, keys_u8, lengths)
+        keys_u8, lengths = self._stage_batch(keys_u8, lengths)
+        with obs.phase("kernel"):
+            self.words, present = self._test_insert(self.words, keys_u8, lengths)
+            if obs.current() is not None:
+                present.block_until_ready()
+        with obs.phase("d2h"):
+            out = np.asarray(present)
         self.n_inserted += B
-        return np.asarray(present)[:B]
+        return out[:B]
 
     @property
     def words_logical(self) -> np.ndarray:
@@ -515,8 +586,7 @@ class BlockedBloomFilter(_FilterBase):
             "block_bits": self.config.block_bits,
             "n_inserted": self.n_inserted,
             "n_queried": self.n_queried,
-            "fill_ratio": self.fill_ratio(),
-            "estimated_fpr": self.estimated_fpr(),
+            **self._fpr_gauges(),
         }
 
     # persistence (raw little-endian words, row-major; NOT the Redis bitmap

@@ -1,0 +1,95 @@
+"""Thread-local request context + phase timers.
+
+The server opens a :func:`request` around every RPC; lower layers
+(``filter.py`` packing/dispatch, protocol decode/encode) wrap their work
+in :func:`phase` spans. Phases accumulate on the innermost active
+context; with no context active a span is a no-op ``yield``, so the
+library hot path outside the server pays one truthy check per span.
+
+Phase vocabulary (keep to these names so dashboards line up across the
+server, ``bench.py``, and the slowlog):
+
+* ``decode``    — wire bytes -> request dict (msgpack)
+* ``host_prep`` — key packing + batch padding on the host
+* ``h2d``       — staging packed arrays onto the device
+* ``kernel``    — jitted device work (dispatch + completion fence)
+* ``d2h``       — device results -> host arrays
+* ``encode``    — response dict -> wire bytes
+
+Under JAX async dispatch the h2d/kernel boundary is approximate (the
+transfer may still be in flight when dispatch starts); the completion
+fence inside ``kernel`` makes the SUM honest, which is what the
+transport-bound vs code-bound triage needs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Iterator, Optional
+
+_tls = threading.local()
+
+
+def new_rid() -> str:
+    """16-hex-char request id; cheap, collision-safe at slowlog scale."""
+    return "%016x" % random.getrandbits(64)
+
+
+class RequestContext:
+    """Per-request accumulator: id, batch size, phase durations."""
+
+    __slots__ = ("method", "rid", "batch", "summary", "phases", "started_at")
+
+    def __init__(self, method: str, rid: Optional[str] = None):
+        self.method = method
+        self.rid = rid or new_rid()
+        self.batch = 0
+        self.summary = ""
+        self.phases: dict[str, float] = {}
+        self.started_at = time.time()
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        # += : a phase may run more than once per request (e.g. kernel
+        # twice for the query-then-insert presence fallback)
+        self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+
+def current() -> Optional[RequestContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def current_rid() -> Optional[str]:
+    ctx = current()
+    return ctx.rid if ctx is not None else None
+
+
+@contextlib.contextmanager
+def request(method: str, rid: Optional[str] = None) -> Iterator[RequestContext]:
+    """Install a fresh RequestContext for this thread (re-entrant: the
+    previous context is restored on exit, so nested server calls don't
+    cross-contaminate phases)."""
+    ctx = RequestContext(method, rid)
+    prev = current()
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Time a named phase into the active request context (no-op without
+    one)."""
+    ctx = current()
+    if ctx is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ctx.add_phase(name, time.perf_counter() - t0)

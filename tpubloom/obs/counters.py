@@ -1,0 +1,39 @@
+"""Process-global event counters for layers below the server.
+
+Kernel-selection and other library-level code has no handle on a
+``BloomService`` (it may run in a bare-library process), so events that
+must be visible in ``/metrics`` — e.g. a Pallas geometry probe demoting
+the process to the scatter path — land here. The exposition layer merges
+these with the server's per-RPC counters; ``Stats`` RPC snapshots include
+them under ``process_counters``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_lock = threading.Lock()
+_counters: dict[str, int] = defaultdict(int)
+
+
+def incr(name: str, n: int = 1) -> None:
+    with _lock:
+        _counters[name] += n
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def global_counters() -> dict[str, int]:
+    """Snapshot copy of all process-global counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_for_tests() -> None:
+    """Zero everything — test isolation only."""
+    with _lock:
+        _counters.clear()

@@ -1,0 +1,72 @@
+"""Background HTTP thread serving ``GET /metrics`` (Prometheus scrape).
+
+A ``ThreadingHTTPServer`` on its own daemon thread — the gRPC data path
+never blocks on a scrape; a scrape only contends for the per-filter op
+locks while reading gauges (microseconds per filter). ``/healthz``
+answers 200 for liveness probes without touching any filter.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("tpubloom.obs")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Own the listener + thread; ``port`` holds the bound port (pass
+    port 0 for an ephemeral one — tests and the smoke benchmark do)."""
+
+    def __init__(self, render_fn, port: int = 0, host: str = "0.0.0.0"):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = render_fn().encode()
+                    except Exception:  # a broken gauge must not 500 forever silently
+                        log.exception("metrics render failed")
+                        self.send_error(500, "metrics render failed")
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    body = json.dumps({"ok": True}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404, "try /metrics or /healthz")
+
+            def log_message(self, fmt, *args):  # scrapes are chatty; route to logging
+                log.debug("metrics http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpubloom-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)
+
+
+def start_metrics_server(service, port: int = 0, host: str = "0.0.0.0") -> MetricsServer:
+    """Serve ``render_service(service)`` at ``http://host:port/metrics``."""
+    from tpubloom.obs.exposition import render_service
+
+    return MetricsServer(lambda: render_service(service), port=port, host=host)

@@ -992,21 +992,37 @@ def _fat_geometry_compiles(
             fat_sweep_insert, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
             with_presence=presence, pack=pk,
         )
-    try:
-        jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
-        ok = True
-    except Exception as e:  # noqa: BLE001 — any compile failure demotes
-        ok = False
+    # Two attempts before caching ok=False: this environment's compile
+    # service surfaces transient failures (dropped connections, HTTP 500)
+    # as generic exceptions, indistinguishable from a real Mosaic limit —
+    # and a cached False silently demotes the process to slower
+    # shapes/scatter for its lifetime (ADVICE r5 #2; bench.py retries the
+    # same failure mode). A real scoped-VMEM OOM fails both attempts.
+    ok, last_exc = False, None
+    for attempt in range(2):
+        try:
+            jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
+            ok = True
+            break
+        except Exception as e:  # noqa: BLE001 — any compile failure demotes
+            last_exc = e
+    if not ok:
         import warnings
 
+        from tpubloom.obs import counters as obs_counters
+
+        # visible in /metrics as tpubloom_geometry_probe_demotions_total
+        # — a nonzero value on a TPU host says the process is running
+        # demoted and a restart/investigation is warranted
+        obs_counters.incr("geometry_probe_demotions")
         warnings.warn(
             f"tpubloom: fat-sweep geometry {geom} failed its probe "
-            f"compile on device kind {kind!r}; this geometry is "
+            f"compile twice on device kind {kind!r}; this geometry is "
             f"disabled for the process (falling back to the next "
             f"shape / scatter path). NOTE: the probe cannot tell a "
-            f"real Mosaic limit from a transient compile-service "
+            f"real Mosaic limit from a persistent compile-service "
             f"error — restart the process to re-probe. Cause: "
-            f"{str(e)[:300]}",
+            f"{str(last_exc)[:300]}",
             RuntimeWarning,
             stacklevel=2,
         )

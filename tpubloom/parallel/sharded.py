@@ -37,7 +37,18 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6: experimental namespace + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:  # renamed from check_rep in newer jax
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(f, **kwargs)
+
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpubloom.config import FilterConfig
@@ -626,22 +637,50 @@ class ShardedBloomFilter(_FilterBase):
     def delete(self, key) -> None:
         self.delete_batch([key])
 
+    def shard_fill_ratios(self) -> Optional[list]:
+        """Per-shard fraction of set bits (None for counting configs) —
+        the /metrics ``tpubloom_shard_fill_ratio{filter,shard}`` gauge.
+        Routing-skew triage: shards fill ~uniformly under the routing
+        hash, so one shard running hot means a key-distribution problem
+        (or a routing regression) that the GLOBAL fill ratio averages
+        away. One device reduction, O(shards) bytes D2H."""
+        if self.config.counting:
+            return None
+        per_word = jax.lax.population_count(
+            self.words.reshape(self.config.shards, -1)
+        )
+        # float32 accumulator, same tradeoff as bitops.popcount_fill:
+        # no uint32 overflow at m_per_shard > 2^32 bits, gauge-grade
+        # precision
+        counts = np.asarray(jnp.sum(per_word.astype(jnp.float32), axis=1))
+        return [float(c) / self.config.m_per_shard for c in counts]
+
     def stats(self) -> dict:
-        return {
+        base = {
             "m": self.config.m,
             "k": self.config.k,
             "shards": self.config.shards,
             "devices": int(self.mesh.devices.size),
             "n_inserted": self.n_inserted,
             "n_queried": self.n_queried,
-            **(
-                {}
-                if self.config.counting
-                else {
-                    "fill_ratio": self.fill_ratio(),
-                    "estimated_fpr": self.estimated_fpr(),
-                }
-            ),
+        }
+        if self.config.counting:
+            return base
+        # one per-shard popcount serves every gauge: shards are equal
+        # sized, so the global fill is exactly the mean of the per-shard
+        # fills — no second O(m) reduction under the caller's op lock
+        fills = self.shard_fill_ratios()
+        fill = float(np.mean(fills))
+        estimated = fill**self.config.k
+        predicted = self.predicted_fpr()
+        return {
+            **base,
+            "fill_ratio": fill,
+            "bits_set": int(round(fill * self.config.m)),
+            "estimated_fpr": estimated,
+            "predicted_fpr": predicted,
+            "fpr_drift": estimated - predicted,
+            "fill_ratio_per_shard": fills,
         }
 
     @property

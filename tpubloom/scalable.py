@@ -231,7 +231,7 @@ class _ScalableCore:
         )
 
     def stats(self) -> dict:
-        return {
+        st = {
             "n_layers": self.n_layers,
             "n_inserted": self.n_inserted,
             "capacity_current_layer": self._layer_caps[-1],
@@ -239,6 +239,17 @@ class _ScalableCore:
             "total_bits": sum(layer.config.m for layer in self.layers),
             "compound_fpr_bound": self.compound_fpr_bound(),
         }
+        if all(hasattr(layer, "estimated_fpr") for layer in self.layers):
+            # observed compound FPR (a query is a false positive when ANY
+            # layer false-positives) vs the design bound = the scalable
+            # variant's drift gauge
+            miss = 1.0
+            for layer in self.layers:
+                miss *= 1.0 - layer.estimated_fpr()
+            st["estimated_fpr"] = 1.0 - miss
+            st["fpr_drift"] = st["estimated_fpr"] - st["compound_fpr_bound"]
+            st["predicted_fpr"] = st["compound_fpr_bound"]
+        return st
 
 
 class ScalableBloomFilter(_ScalableCore):

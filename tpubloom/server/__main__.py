@@ -1,4 +1,8 @@
-"""``python -m tpubloom.server [port] [checkpoint_dir]``"""
+"""``python -m tpubloom.server [port] [checkpoint_dir] [--metrics-port N]``
+
+``--metrics-port`` starts the background Prometheus exposition thread
+(``GET /metrics``; ``tpubloom.obs``) next to the gRPC listener.
+"""
 
 from tpubloom.server.service import main
 

@@ -18,6 +18,12 @@ and leaves retry to the caller, the new framework does better):
   filter yet) is healed transparently: the client replays the original
   ``create_filter`` request with ``exist_ok=True, restore=True`` — the
   server restores the newest checkpoint — then retries the op once.
+
+Observability: every RPC is stamped with a generated request id
+(``self.last_rid`` after the call) which the server folds into its
+profiler spans and slowlog entries — ``slowlog_get()`` entries carry the
+same ids, so a slow call seen client-side can be found server-side.
+Retries of one logical call share the rid.
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ from typing import Optional, Sequence
 import grpc
 import numpy as np
 
+from tpubloom.obs.context import new_rid
 from tpubloom.server import protocol
 
 # delete is always a counting-filter counter decrement — never idempotent
@@ -52,6 +59,7 @@ class BloomClient:
         self.max_retries = max_retries
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        self.last_rid: Optional[str] = None
         self._creations: dict[str, dict] = {}
         self._channel = grpc.insecure_channel(
             address,
@@ -92,6 +100,11 @@ class BloomClient:
         )
 
     def _rpc(self, method: str, req: dict, *, force_no_retry: bool = False) -> dict:
+        # request-correlation id: one per LOGICAL call (retries and the
+        # NOT_FOUND heal's final retry share it); exposed as last_rid so
+        # callers can find their request in the server slowlog/trace
+        self.last_rid = rid = new_rid()
+        req = {**req, "rid": rid}
         # Counting-filter inserts are scatter-ADDs, not idempotent OR —
         # a replayed insert that DID land double-increments counters, so a
         # later delete leaves residue (stuck false positives). Same reason
@@ -134,6 +147,7 @@ class BloomClient:
                     "CreateFilter",
                     {**creation, "exist_ok": True, "restore": True},
                 )
+                self.last_rid = rid  # the heal is internal; report ours
                 recreated = True
 
     # -- service-level -------------------------------------------------------
@@ -281,6 +295,18 @@ class BloomClient:
 
     def checkpoint(self, name: str, *, wait: bool = True) -> dict:
         return self._rpc("Checkpoint", {"name": name, "wait": wait})
+
+    # -- observability -------------------------------------------------------
+
+    def slowlog_get(self, n: Optional[int] = None) -> list:
+        """Slowest server requests (slowest first), Redis SLOWLOG GET
+        parity. Entries carry the rid this client stamped on each call."""
+        req = {"n": n} if n is not None else {}
+        return self._rpc("SlowlogGet", req)["entries"]
+
+    def slowlog_reset(self) -> int:
+        """Clear the server slowlog; returns how many entries dropped."""
+        return self._rpc("SlowlogReset", {})["cleared"]
 
     def close(self) -> None:
         self._channel.close()

@@ -1,10 +1,16 @@
-"""Server observability: counters + latency histograms.
+"""Server observability: counters + latency/phase histograms.
 
 Parity: the reference gem has no metrics; operators lean on Redis
 INFO/SLOWLOG (SURVEY.md §5 "Metrics/logging/observability"). The build
 equivalent pinned there: keys inserted/queried, batch sizes, kernel/request
 latency, checkpoint lag, fill ratio & predicted FPR (the filter classes
 provide the last two via ``stats()``).
+
+This module holds the in-process numbers; :mod:`tpubloom.obs.exposition`
+renders them as a Prometheus scrape and :mod:`tpubloom.obs.slowlog` keeps
+the per-request tail. ``Metrics.observe_rpc`` also files the per-phase
+breakdown (decode/host_prep/h2d/kernel/d2h/encode) the request context
+collected, keyed ``"<method>/<phase>"``.
 """
 
 from __future__ import annotations
@@ -12,12 +18,13 @@ from __future__ import annotations
 import threading
 import time
 from collections import defaultdict
+from typing import Optional
 
 
 class LatencyHistogram:
-    """Fixed log2 buckets from 1us to ~67s — cheap, lock-free enough."""
+    """Fixed log2 buckets from 1us to ~67s — O(1) observe via bit_length."""
 
-    BUCKETS = [2**i for i in range(27)]  # microseconds
+    BUCKETS = [2**i for i in range(27)]  # microsecond upper bounds
 
     def __init__(self):
         self.counts = [0] * (len(self.BUCKETS) + 1)
@@ -28,17 +35,30 @@ class LatencyHistogram:
         us = seconds * 1e6
         self.total_us += us
         self.n += 1
-        for i, b in enumerate(self.BUCKETS):
-            if us < b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        # us < 2^i  <=>  int(us).bit_length() <= i, so bit_length IS the
+        # bucket index (clamped into the overflow bucket) — no linear scan
+        self.counts[min(int(us).bit_length(), len(self.BUCKETS))] += 1
+
+    def cumulative(self) -> list:
+        """Cumulative bucket counts (len(BUCKETS)+1, last = n) — the
+        Prometheus ``le`` series."""
+        out, cum = [], 0
+        for c in self.counts:
+            cum += c
+            out.append(cum)
+        return out
+
+    def export(self) -> dict:
+        return {"counts": list(self.counts), "total_us": self.total_us, "n": self.n}
 
     def summary(self) -> dict:
         if not self.n:
             return {"n": 0}
-        cum = 0
-        out = {"n": self.n, "mean_us": self.total_us / self.n}
+        out = {
+            "n": self.n,
+            "mean_us": self.total_us / self.n,
+            "buckets_cum": self.cumulative(),
+        }
         for q in (0.5, 0.99):
             target = q * self.n
             cum = 0
@@ -53,36 +73,48 @@ class LatencyHistogram:
 
 
 class Metrics:
-    """Process-wide counters + per-RPC latency histograms."""
+    """Process-wide counters + per-RPC latency and phase histograms."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: dict[str, int] = defaultdict(int)
         self.latency: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
+        #: "<method>/<phase>" -> histogram (same buckets as latency)
+        self.phases: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
         self.started_at = time.time()
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] += n
 
-    def time_rpc(self, method: str):
-        m = self
-
-        class _Timer:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                with m._lock:
-                    m.latency[method].observe(time.perf_counter() - self.t0)
-
-        return _Timer()
+    def observe_rpc(
+        self, method: str, seconds: float, phases: Optional[dict] = None
+    ) -> None:
+        """File one finished RPC: total latency + its phase breakdown."""
+        with self._lock:
+            self.latency[method].observe(seconds)
+            for phase_name, phase_s in (phases or {}).items():
+                self.phases[f"{method}/{phase_name}"].observe(phase_s)
 
     def snapshot(self) -> dict:
+        from tpubloom.obs import counters as global_counters
+
         with self._lock:
             return {
                 "uptime_s": time.time() - self.started_at,
                 "counters": dict(self.counters),
                 "latency": {k: v.summary() for k, v in self.latency.items()},
+                "phases": {k: v.summary() for k, v in self.phases.items()},
+                "process_counters": global_counters.global_counters(),
+            }
+
+    def export(self) -> dict:
+        """Raw histogram data for the Prometheus renderer."""
+        with self._lock:
+            return {
+                "uptime_s": time.time() - self.started_at,
+                "counters": dict(self.counters),
+                "bucket_bounds_us": list(LatencyHistogram.BUCKETS),
+                "latency": {k: v.export() for k, v in self.latency.items()},
+                "phases": {k: v.export() for k, v in self.phases.items()},
             }

@@ -13,6 +13,11 @@ first-class Ruby support (the reference's ecosystem), and keeps the wire
 format hand-decodable. Every message is a msgpack map; bulk key payloads
 are msgpack ``bin`` arrays.
 
+Request correlation: any request map MAY carry a ``rid`` field (string
+request id). The server folds it into profiler spans and slowlog entries;
+the stock Python client stamps one on every call. Servers generate one
+when absent, so old clients stay compatible.
+
 Service: ``/tpubloom.BloomService/<Method>`` for Method in METHODS.
 """
 
@@ -33,6 +38,8 @@ METHODS = (
     "Clear",
     "Stats",
     "Checkpoint",
+    "SlowlogGet",
+    "SlowlogReset",
 )
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -34,6 +35,8 @@ import numpy as np
 from tpubloom import checkpoint as ckpt
 from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
+from tpubloom.obs import context as obs
+from tpubloom.obs.slowlog import Slowlog, summarize_request
 from tpubloom.server import protocol
 from tpubloom.server.metrics import Metrics
 from tpubloom.utils import tracing
@@ -62,13 +65,14 @@ class _Managed:
 class BloomService:
     """Method handlers; state = {name: _Managed}."""
 
-    def __init__(self, sink_factory=None):
+    def __init__(self, sink_factory=None, *, slowlog_capacity: int = 128):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter)."""
         self._filters: dict[str, _Managed] = {}
         self._lock = threading.Lock()
         self._sink_factory = sink_factory or (lambda config: None)
         self.metrics = Metrics()
+        self.slowlog = Slowlog(capacity=slowlog_capacity)
 
     # -- helpers -------------------------------------------------------------
 
@@ -325,7 +329,9 @@ class BloomService:
     def InsertBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
         want_presence = bool(req.get("return_presence"))
-        with mf.lock, tracing.annotate("InsertBatch", batch=len(req["keys"])):
+        with mf.lock, tracing.request_span(
+            "InsertBatch", batch=len(req["keys"]), rid=obs.current_rid()
+        ):
             presence = None
             if want_presence:
                 # fused test-and-insert (blocked filters run it as one
@@ -349,11 +355,15 @@ class BloomService:
 
     def QueryBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
-        with mf.lock, tracing.annotate("QueryBatch", batch=len(req["keys"])):
+        with mf.lock, tracing.request_span(
+            "QueryBatch", batch=len(req["keys"]), rid=obs.current_rid()
+        ):
             # see class docstring: donation makes the lock mandatory
             hits = mf.filter.include_batch(req["keys"])
         self.metrics.count("keys_queried", len(req["keys"]))
-        return {"ok": True, "hits": np.packbits(hits).tobytes(), "n": len(req["keys"])}
+        with obs.phase("encode"):
+            packed = np.packbits(hits).tobytes()
+        return {"ok": True, "hits": packed, "n": len(req["keys"])}
 
     def DeleteBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -385,8 +395,51 @@ class BloomService:
             if mf.checkpointer:
                 st["checkpoints_written"] = mf.checkpointer.checkpoints_written
                 st["checkpoint_seq"] = mf.checkpointer.seq
+                st["checkpoint"] = mf.checkpointer.obs_stats()
             return {"ok": True, "stats": st}
         return {"ok": True, "server": self.metrics.snapshot()}
+
+    def SlowlogGet(self, req: dict) -> dict:
+        """Redis ``SLOWLOG GET [n]`` parity: slowest requests first, each
+        with method, args summary, batch size, duration, request id,
+        timestamp, and the per-phase breakdown."""
+        n = req.get("n")
+        return {
+            "ok": True,
+            "entries": self.slowlog.entries(None if n is None else int(n)),
+        }
+
+    def SlowlogReset(self, req: dict) -> dict:
+        """Redis ``SLOWLOG RESET`` parity."""
+        return {"ok": True, "cleared": self.slowlog.reset()}
+
+    def gauge_snapshot(self) -> list:
+        """Per-filter gauge readings for the Prometheus exposition: each
+        entry = {filter, stats, shard_fill?, checkpoint?}. Reads run under
+        the filter's op lock — a gauge must never read a device buffer a
+        donating insert is recycling."""
+        with self._lock:
+            filters = list(self._filters.items())
+        out = []
+        for name, mf in filters:
+            with mf.lock:
+                st = mf.filter.stats() if hasattr(mf.filter, "stats") else {}
+                # sharded stats() already paid the per-shard popcount —
+                # don't run the O(m) reduction twice under the op lock
+                shard_fill = st.get("fill_ratio_per_shard")
+                if shard_fill is None and hasattr(mf.filter, "shard_fill_ratios"):
+                    shard_fill = mf.filter.shard_fill_ratios()
+            out.append(
+                {
+                    "filter": name,
+                    "stats": st,
+                    "shard_fill": shard_fill,
+                    "checkpoint": (
+                        mf.checkpointer.obs_stats() if mf.checkpointer else None
+                    ),
+                }
+            )
+        return out
 
     def Checkpoint(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -434,17 +487,48 @@ def _wrap(service: BloomService, method_name: str):
     handler = getattr(service, method_name)
 
     def unary_unary(request: bytes, context) -> bytes:
-        with service.metrics.time_rpc(method_name):
+        t0 = time.perf_counter()
+        with obs.request(method_name) as rctx:
             try:
-                req = protocol.decode(request)
-                return protocol.encode(handler(req))
+                with obs.phase("decode"):
+                    req = protocol.decode(request)
+                # correlate with the client's id when it sent one; the
+                # context pre-generated a server-side id otherwise
+                if isinstance(req.get("rid"), str) and req["rid"]:
+                    rctx.rid = req["rid"]
+                keys = req.get("keys")
+                rctx.batch = len(keys) if isinstance(keys, list) else 0
+                rctx.summary = summarize_request(method_name, req)
+                resp = handler(req)
             except protocol.BloomServiceError as e:
-                return protocol.encode(protocol.error_response(e.code, e.message))
+                resp = protocol.error_response(e.code, e.message)
             except Exception as e:  # surface, don't kill the channel
                 log.exception("RPC %s failed", method_name)
-                return protocol.encode(
-                    protocol.error_response("INTERNAL", f"{type(e).__name__}: {e}")
+                resp = protocol.error_response(
+                    "INTERNAL", f"{type(e).__name__}: {e}"
                 )
+            try:
+                with obs.phase("encode"):
+                    raw = protocol.encode(resp)
+            except Exception as e:  # unserializable handler output: keep
+                log.exception("RPC %s response encode failed", method_name)
+                raw = protocol.encode(  # the structured error contract
+                    protocol.error_response(
+                        "INTERNAL",
+                        f"response encode failed: {type(e).__name__}: {e}",
+                    )
+                )
+            duration_s = time.perf_counter() - t0
+            service.metrics.observe_rpc(method_name, duration_s, rctx.phases)
+            service.slowlog.record(
+                method=method_name,
+                duration_s=duration_s,
+                rid=rctx.rid,
+                batch=rctx.batch,
+                args=rctx.summary,
+                phases=rctx.phases,
+            )
+        return raw
 
     return grpc.unary_unary_rpc_method_handler(unary_unary)
 
@@ -474,23 +558,54 @@ def build_server(
 
 
 def main(argv: Optional[list] = None) -> None:
-    """``python -m tpubloom.server [port] [checkpoint_dir]``"""
-    import sys
+    """``python -m tpubloom.server [port] [checkpoint_dir]
+    [--metrics-port N] [--slowlog-capacity N]``"""
+    import argparse
 
-    argv = argv if argv is not None else sys.argv[1:]
-    port = int(argv[0]) if argv else 50051
-    ckpt_dir = argv[1] if len(argv) > 1 else None
+    parser = argparse.ArgumentParser(
+        prog="tpubloom.server", description="tpubloom gRPC server"
+    )
+    parser.add_argument("port", nargs="?", type=int, default=50051)
+    parser.add_argument("checkpoint_dir", nargs="?", default=None)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve Prometheus text format at http://0.0.0.0:PORT/metrics "
+        "(0 picks an ephemeral port; omit to disable)",
+    )
+    parser.add_argument(
+        "--slowlog-capacity",
+        type=int,
+        default=128,
+        help="how many slowest requests SlowlogGet retains (default 128)",
+    )
+    args = parser.parse_args(argv)
+    ckpt_dir = args.checkpoint_dir
     sink_factory = (
         (lambda config: ckpt.FileSink(ckpt_dir)) if ckpt_dir else (lambda config: None)
     )
     logging.basicConfig(level=logging.INFO)
-    service = BloomService(sink_factory=sink_factory)
-    server, bound = build_server(service, f"0.0.0.0:{port}")
+    service = BloomService(
+        sink_factory=sink_factory, slowlog_capacity=args.slowlog_capacity
+    )
+    server, bound = build_server(service, f"0.0.0.0:{args.port}")
     server.start()
     log.info("tpubloom server listening on :%d (checkpoints: %s)", bound, ckpt_dir)
+    metrics_server = None
+    if args.metrics_port is not None:
+        from tpubloom.obs.httpd import start_metrics_server
+
+        metrics_server = start_metrics_server(service, port=args.metrics_port)
+        log.info(
+            "prometheus exposition on http://0.0.0.0:%d/metrics",
+            metrics_server.port,
+        )
     try:
         server.wait_for_termination()
     except KeyboardInterrupt:
         log.info("shutting down: final checkpoints...")
         service.shutdown()
         server.stop(grace=5)
+        if metrics_server is not None:
+            metrics_server.close()

@@ -18,8 +18,11 @@ Usage::
     # or one-shot around a callable:
     result, trace_dir = tracing.profile_call(fn, *args)
 
-The gRPC server wires ``annotate`` around every request so per-request
-spans appear in device traces (tpubloom/server/service.py).
+The gRPC server wires ``request_span`` around every request so
+per-request spans appear in device traces (tpubloom/server/service.py)
+carrying the client-generated request id — the same id the slowlog entry
+records (``tpubloom.obs.slowlog``), so "find slowlog entry rid=X, open
+the trace, search rid=X" is the triage loop.
 """
 
 from __future__ import annotations
@@ -60,6 +63,20 @@ def annotate(name: str, **attrs: Any) -> Iterator[None]:
         name = name + "[" + ",".join(f"{k}={v}" for k, v in attrs.items()) + "]"
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def request_span(
+    name: str, *, batch: int | None = None, rid: str | None = None
+) -> Iterator[None]:
+    """Request-correlated :func:`annotate` span: folds the batch size and
+    request id into the span name, silently dropping absent attrs (a
+    library call without an active RPC has no rid)."""
+    attrs: dict[str, Any] = {}
+    if batch is not None:
+        attrs["batch"] = batch
+    if rid:
+        attrs["rid"] = rid
+    return annotate(name, **attrs)
 
 
 def profile_call(

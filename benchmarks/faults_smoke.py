@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Chaos smoke: boot the server stack on CPU, inject faults, and assert
+the ISSUE-2 robustness surface end to end.
+
+What it drives (fast: small filters, ephemeral ports, < ~20s on CPU):
+
+* checkpoint two generations, **corrupt the newest on disk**, restart
+  the service — restore must fall back a generation, quarantine the
+  corpse to ``<dir>/corrupt/``, report ``DEGRADED`` with a
+  ``checkpoint_corrupt:*`` reason, keep serving reads AND writes, then
+  walk back to ``SERVING`` after the next good checkpoint;
+* an injected ``ckpt.fsync`` fault mid-save — the tmp+rename invariant
+  must leave no partial ``.ckpt`` visible;
+* an in-flight cap of 2 with artificially slow handlers under
+  concurrent clients — excess requests shed with ``RESOURCE_EXHAUSTED``
+  + ``retry_after_ms`` and every retrying call still completes, with
+  **zero double-applied deletes** (rid dedup);
+* the injection counters land in the obs layer (a chaos run is
+  auditable from /metrics).
+
+Run directly (``python benchmarks/faults_smoke.py`` — prints one JSON
+line) or via tier-1 (``tests/test_faults.py::test_faults_smoke`` imports
+:func:`run_smoke`). CI runs both paths so the fault hooks cannot rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def run_smoke() -> dict:
+    """Drive the chaos scenario; returns summary facts (raises on any
+    failure)."""
+    import numpy as np
+
+    from tpubloom import checkpoint as ckpt
+    from tpubloom import faults
+    from tpubloom.obs import counters as obs_counters
+    from tpubloom.server.client import BloomClient
+    from tpubloom.server.protocol import BloomServiceError
+    from tpubloom.server.service import BloomService, build_server
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tpubloom-faults-smoke-")
+    sink_factory = lambda config: ckpt.FileSink(ckpt_dir)  # noqa: E731
+    faults.reset()
+    out: dict = {}
+
+    # -- phase 1: corrupt-newest restore walk --------------------------------
+    service = BloomService(sink_factory=sink_factory)
+    server, port = build_server(service, "127.0.0.1:0")
+    server.start()
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    rng = np.random.default_rng(0)
+    durable = [rng.bytes(16) for _ in range(1000)]
+    client.create_filter("smoke", capacity=50_000, error_rate=0.01)
+    client.insert_batch("smoke", durable)
+    client.checkpoint("smoke", wait=True)  # generation A (good)
+    client.insert_batch("smoke", [rng.bytes(16) for _ in range(200)])
+    client.checkpoint("smoke", wait=True)  # generation B (to corrupt)
+
+    # fsync fault mid-save: no partial file may appear
+    faults.arm("ckpt.fsync", "once")
+    try:
+        client.checkpoint("smoke", wait=True)
+        raise AssertionError("fsync fault did not surface")
+    except BloomServiceError as e:
+        assert e.code == "CKPT_FAILED", e
+    faults.reset()
+    assert not any(
+        fn.endswith(".tmp") for fn in os.listdir(ckpt_dir)
+    ), "partial checkpoint visible after injected fsync fault"
+
+    client.close()
+    server.stop(grace=None)
+    del service
+
+    sink = ckpt.FileSink(ckpt_dir)
+    newest = sink.list_seqs("smoke")[0]
+    path = sink._path("smoke", newest)
+    blob = bytearray(open(path, "rb").read())
+    blob[-5] ^= 0xFF  # payload bit rot
+    open(path, "wb").write(bytes(blob))
+
+    service = BloomService(sink_factory=sink_factory)
+    server, port = build_server(service, "127.0.0.1:0")
+    server.start()
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    client.create_filter(
+        "smoke", capacity=50_000, error_rate=0.01, exist_ok=True
+    )
+    assert client.include_batch("smoke", durable).all(), (
+        "fallback generation lost checkpointed keys"
+    )
+    health = client.health()
+    assert health["status"] == "DEGRADED", health
+    assert any(r.startswith("checkpoint_corrupt") for r in health["reasons"])
+    out["restored_past_corruption"] = True
+    out["quarantined"] = sorted(
+        os.listdir(os.path.join(ckpt_dir, "corrupt"))
+    )
+    client.insert_batch("smoke", [b"post-corruption0"])  # writes still work
+    client.checkpoint("smoke", wait=True)  # a good generation heals
+    assert client.health()["status"] == "SERVING"
+    out["health_recovered"] = True
+    client.close()
+    server.stop(grace=None)
+    del service
+
+    # -- phase 2: overload shed + retry, zero double-deletes -----------------
+    service = BloomService(
+        sink_factory=sink_factory, max_in_flight=2, retry_after_ms=20
+    )
+    orig_delete = service.DeleteBatch
+
+    def slow_delete(req):
+        time.sleep(0.1)
+        return orig_delete(req)
+
+    service.DeleteBatch = slow_delete
+    server, port = build_server(service, "127.0.0.1:0")
+    server.start()
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    client.create_filter(
+        "cnt", capacity=20_000, error_rate=0.01, counting=True
+    )
+    keys = [b"smoke-dup-%06d" % i for i in range(32)]
+    client.insert_batch("cnt", keys)
+    client.insert_batch("cnt", keys)  # every key at count 2
+
+    failures: list = []
+
+    def delete_chunk(chunk):
+        try:
+            c = BloomClient(
+                f"127.0.0.1:{port}", max_retries=10, backoff_base=0.02
+            )
+            try:
+                c.delete_batch("cnt", chunk)
+            finally:
+                c.close()
+        except Exception as e:  # noqa: BLE001
+            failures.append(e)
+
+    threads = [
+        threading.Thread(target=delete_chunk, args=(keys[i::6],))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, failures
+    sheds = service.metrics.snapshot()["counters"].get("requests_shed", 0)
+    assert sheds > 0, "cap 2 with 6 concurrent slow deletes never shed"
+    out["sheds"] = sheds
+    # exactly-once deletes: every key went 2 -> 1, so all still present
+    double_applied = int((~client.include_batch("cnt", keys)).sum())
+    assert double_applied == 0, f"{double_applied} deletes double-applied"
+    out["deletes_double_applied"] = 0
+    client.close()
+    server.stop(grace=None)
+
+    out["faults_injected"] = obs_counters.get("faults_injected")
+    out["ckpt_corrupt_detected"] = obs_counters.get("ckpt_corrupt_detected")
+    assert out["faults_injected"] >= 1
+    assert out["ckpt_corrupt_detected"] >= 1
+    return out
+
+
+def main() -> int:
+    if os.environ.get("JAX_PLATFORMS") is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # runnable as `python benchmarks/faults_smoke.py` from a checkout
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    result = run_smoke()
+    print(json.dumps({"ok": True, **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@
 
 require "grpc"
 require "msgpack"
+require "securerandom"
 
 class Redis
   class Bloomfilter
@@ -34,10 +35,28 @@ class Redis
 
         IDENTITY = proc { |bytes| bytes }
 
-        # Non-idempotent RPCs are never auto-retried: a counting-filter
-        # delete (or insert — counters are scatter-ADDs, not idempotent
-        # ORs) that DID land would be applied twice on replay.
-        NO_RETRY = %w[DeleteBatch].freeze
+        # Structured server-side error (protocol error_response): code,
+        # message, and optional machine-readable details (e.g. the
+        # retry_after_ms hint on overload sheds).
+        class ServiceError < RuntimeError
+          attr_reader :code, :details
+
+          def initialize(code, message, details = {})
+            super("tpubloom #{code}: #{message}")
+            @code = code
+            @details = details || {}
+          end
+        end
+
+        # Codes meaning "the server refused BEFORE running the handler" —
+        # replaying is safe for every method, idempotent or not.
+        SHED_CODES = %w[RESOURCE_EXHAUSTED DRAINING].freeze
+
+        # DeleteBatch is auto-retried since ISSUE 2: each logical call
+        # carries a rid that retries reuse, and the server's rid->response
+        # dedup cache answers a replay whose first attempt landed instead
+        # of double-decrementing. Counting/presence INSERTS remain
+        # non-retried on transport errors (scatter-ADDs; no dedup there).
 
         # opts mirrors the reference constructor options plus:
         #   :address       - "host:port" of the tpubloom server (default
@@ -135,10 +154,13 @@ class Redis
         end
 
         def rpc(method, payload, no_retry: false)
-          no_retry ||= NO_RETRY.include?(method) ||
-                       (method == "InsertBatch" && counting?)
+          no_retry ||= method == "InsertBatch" && counting?
           retries = no_retry ? 0 : @max_retries
+          # one rid per LOGICAL call — retries and the NOT_FOUND heal's
+          # final retry reuse it; the server's DeleteBatch dedup keys on it
+          payload = payload.merge("rid" => SecureRandom.hex(8))
           attempt = 0
+          shed_attempt = 0
           recreated = false
           begin
             rpc_once(method, payload)
@@ -147,10 +169,21 @@ class Redis
             sleep([0.2 * (2**attempt), 5.0].min * (0.5 + rand))
             attempt += 1
             retry
-          rescue RuntimeError => e
+          rescue ServiceError => e
+            if SHED_CODES.include?(e.code)
+              # shed before execution — safe to replay any method; pace
+              # off the server's retry_after_ms hint when it beats backoff
+              raise if shed_attempt >= @max_retries
+              delay = [0.2 * (2**shed_attempt), 5.0].min
+              hint = e.details["retry_after_ms"]
+              delay = [delay, hint / 1000.0].max if hint
+              sleep(delay * (0.75 + rand / 2))
+              shed_attempt += 1
+              retry
+            end
             # A restarted server has not seen the filter yet: re-create it
             # (restores the newest checkpoint), then retry the op once.
-            raise unless e.message.include?("NOT_FOUND") &&
+            raise unless e.code == "NOT_FOUND" &&
                          method != "CreateFilter" && !recreated
             recreated = true
             create_filter
@@ -168,7 +201,9 @@ class Redis
           resp = MessagePack.unpack(raw)
           unless resp["ok"]
             err = resp["error"] || {}
-            raise "tpubloom #{err['code'] || 'UNKNOWN'}: #{err['message']}"
+            raise ServiceError.new(
+              err["code"] || "UNKNOWN", err["message"], err["details"]
+            )
           end
           resp
         end

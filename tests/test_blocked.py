@@ -9,6 +9,8 @@ blocked spec).
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings, strategies as st
 
 from tpubloom import BlockedBloomFilter, CPUBlockedBloomFilter, FilterConfig

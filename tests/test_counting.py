@@ -3,6 +3,8 @@ insert/delete/query mix, exercises scatter-add)."""
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -10,6 +10,8 @@ semantics are pinned against cpu_ref) is the ground truth here.
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings, strategies as st
 
 import jax

@@ -2,6 +2,9 @@
 auto must never pick an unsupported shape; R selection is no longer
 restricted to {512, 1024})."""
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings, strategies as st
 
 from tpubloom.ops.sweep import (

@@ -4,6 +4,8 @@ for CI speed, the full config runs in benchmarks/)."""
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -8,6 +8,8 @@ vectors on every input hypothesis can dream up.
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -16,6 +16,8 @@ overflow chunk loop.
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; skip cleanly without
 from hypothesis import given, settings, strategies as st
 
 from tpubloom import CPUBlockedBloomFilter, FilterConfig

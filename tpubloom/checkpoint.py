@@ -20,14 +20,33 @@ checkpoint of the device bit-array"):
   RESP client (``tpubloom.server.resp``) — ``SET key_name <bitmap>`` exactly
   like the reference would have left it;
 * **monotonic sequence numbers** tag every snapshot; restore picks the
-  newest. Crash-consistency contract: a lagging checkpoint only loses the
-  most recent inserts, never corrupts (scatter-OR is monotone) — the
-  fault-injection test pins this.
+  newest *intact* generation. Crash-consistency contract: a lagging
+  checkpoint only loses the most recent inserts, never corrupts
+  (scatter-OR is monotone) — the fault-injection tests pin this;
+* **format v2 integrity framing** (ISSUE 2): every blob is
+  ``MAGIC2 | header_len u64le | header_crc32c u32le | header_json |
+  payload`` with the payload's CRC32C and byte length recorded in the
+  header. Restore detects torn, truncated, and bit-rotted files instead
+  of trusting the newest blob byte-for-byte; on a :class:`FileSink` it
+  **walks generations newest→oldest** past corrupt files, moves each one
+  to ``<dir>/corrupt/`` (quarantine — a re-walk must not trip over the
+  same file twice), and bumps the process-global
+  ``ckpt_corrupt_detected`` counter. v1 blobs (``TPUBLOOM1``) still
+  restore — structural validation only, as before;
+* **retention GC**: the async checkpointer prunes to the last N good
+  generations after each successful write (never the quarantine dir).
+
+Fault points (:mod:`tpubloom.faults`): ``ckpt.write`` (before the tmp
+write; honors the ``torn`` directive by silently truncating the blob —
+the bit-rot-after-fsync case), ``ckpt.fsync`` (before fsync+rename: a
+raise here must leave NO partial final file), ``ckpt.restore_read``
+(before a blob read on restore).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import re
@@ -37,9 +56,29 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from tpubloom import faults
 from tpubloom.config import FilterConfig, identity_mismatch
+from tpubloom.obs import counters as _counters
+from tpubloom.utils.crc32c import crc32c
 
-MAGIC = b"TPUBLOOM1\n"
+log = logging.getLogger("tpubloom.checkpoint")
+
+MAGIC = b"TPUBLOOM1\n"  # v1: no integrity framing (read-compat only)
+MAGIC_V2 = b"TPUBLOOM2\n"  # v2: header + payload CRC32C
+
+#: Default checkpoint generations the async checkpointer's GC retains.
+#: >1 by design: the newest generation being corrupt is exactly the case
+#: the restore walk exists for, so there must be a predecessor to fall
+#: back to.
+DEFAULT_RETAIN = 4
+
+
+class CheckpointCorruptError(ValueError):
+    """A blob failed integrity validation (torn, truncated, bit-rotted).
+
+    Distinct from plain ValueError config/identity mismatches: corruption
+    is skippable (fall back a generation), a mismatch is an operator
+    error that must surface."""
 
 #: Base-config identity for scalable checkpoints: the template's m/k are
 #: placeholders (each layer derives its own from the growth policy), so
@@ -73,16 +112,16 @@ def _serialize(
     else:
         payload = words_to_redis_bitmap(words.reshape(-1), config.m)
         fmt = "redis_bitmap"
-    header = json.dumps(
+    return _frame(
         {
             "config": config.to_dict(),
             "seq": seq,
             "format": fmt,
             "time": time.time(),
             "extra": extra or {},
-        }
-    ).encode()
-    return MAGIC + len(header).to_bytes(8, "little") + header + payload
+        },
+        payload,
+    )
 
 
 def _serialize_scalable(
@@ -101,7 +140,7 @@ def _serialize_scalable(
         for w in layer_words
     ]
     meta = {**meta, "layer_nbytes": [len(p) for p in payloads]}
-    header = json.dumps(
+    return _frame(
         {
             "config": base_config.to_dict(),
             "seq": seq,
@@ -109,18 +148,68 @@ def _serialize_scalable(
             "time": time.time(),
             "extra": extra or {},
             "scalable": meta,
-        }
-    ).encode()
-    return MAGIC + len(header).to_bytes(8, "little") + header + b"".join(payloads)
+        },
+        b"".join(payloads),
+    )
+
+
+def _frame(header: dict, payload: bytes) -> bytes:
+    """Format-v2 writer: the header records the payload's length and
+    CRC32C; the header bytes get their own CRC32C right after the length
+    word, so corruption anywhere in the blob is attributable."""
+    header = {**header, "payload_len": len(payload),
+              "payload_crc32c": crc32c(payload)}
+    hdr = json.dumps(header).encode()
+    return (
+        MAGIC_V2
+        + len(hdr).to_bytes(8, "little")
+        + crc32c(hdr).to_bytes(4, "little")
+        + hdr
+        + payload
+    )
 
 
 def _deserialize(data: bytes) -> Tuple[dict, bytes]:
-    if not data.startswith(MAGIC):
-        raise ValueError("not a tpubloom checkpoint (bad magic)")
-    off = len(MAGIC)
-    hlen = int.from_bytes(data[off : off + 8], "little")
-    header = json.loads(data[off + 8 : off + 8 + hlen])
-    return header, data[off + 8 + hlen :]
+    """Parse + integrity-check a blob (v2 full CRC, v1 structural only).
+
+    Raises :class:`CheckpointCorruptError` on anything torn, truncated,
+    or bit-rotted; restore treats that as "fall back a generation"."""
+    if data.startswith(MAGIC_V2):
+        off = len(MAGIC_V2)
+        if len(data) < off + 12:
+            raise CheckpointCorruptError("checkpoint truncated in framing")
+        hlen = int.from_bytes(data[off : off + 8], "little")
+        hcrc = int.from_bytes(data[off + 8 : off + 12], "little")
+        hdr = data[off + 12 : off + 12 + hlen]
+        if len(hdr) != hlen:
+            raise CheckpointCorruptError("checkpoint truncated in header")
+        if crc32c(hdr) != hcrc:
+            raise CheckpointCorruptError("checkpoint header CRC32C mismatch")
+        header = json.loads(hdr)  # CRC passed: json is structurally sound
+        payload = data[off + 12 + hlen :]
+        if len(payload) != header["payload_len"]:
+            raise CheckpointCorruptError(
+                f"checkpoint payload truncated: header says "
+                f"{header['payload_len']} bytes, found {len(payload)}"
+            )
+        if crc32c(payload) != header["payload_crc32c"]:
+            raise CheckpointCorruptError("checkpoint payload CRC32C mismatch")
+        return header, payload
+    if data.startswith(MAGIC):
+        # v1 (pre-integrity framing): best-effort structural validation —
+        # a torn v1 header fails json parse; a torn v1 payload is
+        # undetectable here (that is why v2 exists).
+        off = len(MAGIC)
+        hlen = int.from_bytes(data[off : off + 8], "little")
+        raw = data[off + 8 : off + 8 + hlen]
+        if len(raw) != hlen:
+            raise CheckpointCorruptError("v1 checkpoint truncated in header")
+        try:
+            header = json.loads(raw)
+        except ValueError as e:
+            raise CheckpointCorruptError(f"v1 checkpoint header unparseable: {e}")
+        return header, data[off + 8 + hlen :]
+    raise CheckpointCorruptError("not a tpubloom checkpoint (bad magic)")
 
 
 def payload_to_words(config: FilterConfig, header: dict, payload: bytes) -> np.ndarray:
@@ -132,49 +221,103 @@ def payload_to_words(config: FilterConfig, header: dict, payload: bytes) -> np.n
 
 
 class FileSink:
-    """Checkpoints as ``<dir>/<key_name>.<seq>.ckpt`` files (atomic rename)."""
+    """Checkpoints as ``<dir>/<key_name>.<seq>.ckpt`` files (atomic rename).
+
+    Crash invariant (pinned by the chaos suite): a failure at ANY point
+    of ``put`` — including an injected ``ckpt.write``/``ckpt.fsync``
+    fault — leaves no partial ``.ckpt`` visible; either the rename
+    happened with fully-fsynced bytes behind it, or the previous
+    generation is still the newest. Files that fail integrity checks at
+    restore are moved to ``<dir>/corrupt/`` so a re-walk never pays for
+    the same corpse twice."""
+
+    CORRUPT_SUBDIR = "corrupt"
 
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
 
+    def _path(self, key_name: str, seq: int) -> str:
+        return os.path.join(self.directory, f"{key_name}.{seq:012d}.ckpt")
+
     def put(self, key_name: str, seq: int, blob: bytes) -> None:
-        final = os.path.join(self.directory, f"{key_name}.{seq:012d}.ckpt")
+        final = self._path(key_name, seq)
         tmp = final + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
+        try:
+            directive = faults.fire("ckpt.write")
+            if directive == "torn":
+                # the bit-rot/torn-write case: the write "succeeds" from
+                # the process's view but half the blob is gone — only the
+                # restore-side CRC walk can catch this
+                blob = blob[: max(1, len(blob) // 2)]
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                faults.fire("ckpt.fsync")
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        except BaseException:
+            # never leave a stale tmp behind — a later put of the same
+            # seq must not accidentally resurrect half-written bytes
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def list_seqs(self, key_name: str) -> list:
+        """All generations for ``key_name``, newest first."""
+        return sorted(
+            (
+                int(m.group("seq"))
+                for fn in os.listdir(self.directory)
+                if (m := _CKPT_RE.match(fn)) and m.group("name") == key_name
+            ),
+            reverse=True,
+        )
 
     def latest_seq(self, key_name: str) -> Optional[int]:
-        best = None
-        for fn in os.listdir(self.directory):
-            mm = _CKPT_RE.match(fn)
-            if mm and mm.group("name") == key_name:
-                s = int(mm.group("seq"))
-                best = s if best is None else max(best, s)
-        return best
+        seqs = self.list_seqs(key_name)
+        return seqs[0] if seqs else None
 
     def get(self, key_name: str, seq: Optional[int] = None) -> Optional[bytes]:
         if seq is None:
             seq = self.latest_seq(key_name)
             if seq is None:
                 return None
-        path = os.path.join(self.directory, f"{key_name}.{seq:012d}.ckpt")
+        path = self._path(key_name, seq)
         if not os.path.exists(path):
             return None
+        faults.fire("ckpt.restore_read")
         with open(path, "rb") as f:
             return f.read()
 
-    def prune(self, key_name: str, keep: int = 2) -> None:
-        seqs = sorted(
-            int(m.group("seq"))
-            for fn in os.listdir(self.directory)
-            if (m := _CKPT_RE.match(fn)) and m.group("name") == key_name
-        )
-        for s in seqs[:-keep] if keep else seqs:
-            os.unlink(os.path.join(self.directory, f"{key_name}.{s:012d}.ckpt"))
+    def quarantine(self, key_name: str, seq: int) -> Optional[str]:
+        """Move a corrupt generation into ``<dir>/corrupt/``; returns the
+        new path (None if the file vanished underneath us)."""
+        src = self._path(key_name, seq)
+        qdir = os.path.join(self.directory, self.CORRUPT_SUBDIR)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, os.path.basename(src))
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            return None
+        return dst
+
+    def prune(self, key_name: str, keep: int = 2) -> int:
+        """Drop all but the newest ``keep`` generations (quarantined files
+        live in a subdirectory and are never touched); returns the number
+        of files removed."""
+        seqs = self.list_seqs(key_name)  # newest first
+        pruned = 0
+        for s in seqs[keep:] if keep else seqs:
+            try:
+                os.unlink(self._path(key_name, s))
+                pruned += 1
+            except FileNotFoundError:
+                pass
+        return pruned
 
 
 class RedisSink:
@@ -340,11 +483,66 @@ def restore(
     config and ``scalable_expect`` optionally pins the growth policy.
     ``expect_scalable`` (when not None) rejects a blob of the other kind
     up front — before any device arrays are built.
+
+    Robustness (ISSUE 2): on sinks that expose generations
+    (``list_seqs``, i.e. :class:`FileSink`) and with no explicit ``seq``
+    pinned, corruption in the newest blob is not fatal — the walk falls
+    back generation by generation, quarantining each corrupt file and
+    bumping ``ckpt_corrupt_detected``; a blob unreadable due to an I/O
+    error is skipped (not quarantined — the bytes may be fine) and bumps
+    ``ckpt_restore_read_errors``. Only if every generation is corrupt or
+    absent does restore return None. Identity/config mismatches are NOT
+    skipped: a wrong config must surface, not silently fall back to an
+    older blob that happens to match.
     """
+    if seq is None and hasattr(sink, "list_seqs"):
+        for s in sink.list_seqs(config.key_name):
+            try:
+                blob = sink.get(config.key_name, s)
+            except Exception as e:
+                _counters.incr("ckpt_restore_read_errors")
+                log.warning(
+                    "checkpoint %r seq %d unreadable (%s); trying older",
+                    config.key_name, s, e,
+                )
+                continue
+            if blob is None:
+                continue
+            try:
+                header, payload = _deserialize(blob)
+            except CheckpointCorruptError as e:
+                _counters.incr("ckpt_corrupt_detected")
+                qpath = (
+                    sink.quarantine(config.key_name, s)
+                    if hasattr(sink, "quarantine")
+                    else None
+                )
+                log.error(
+                    "checkpoint %r seq %d corrupt (%s)%s; trying older",
+                    config.key_name, s, e,
+                    f", quarantined to {qpath}" if qpath else "",
+                )
+                continue
+            return _build_filter(
+                config, header, payload, scalable_expect, expect_scalable
+            )
+        return None
     blob = sink.get(config.key_name, seq)
     if blob is None:
         return None
     header, payload = _deserialize(blob)
+    return _build_filter(config, header, payload, scalable_expect, expect_scalable)
+
+
+def _build_filter(
+    config: FilterConfig,
+    header: dict,
+    payload: bytes,
+    scalable_expect: Optional[dict] = None,
+    expect_scalable: Optional[bool] = None,
+):
+    """Validated header+payload -> live filter (shared by both restore
+    paths; the routing below MUST agree with CreateFilter's)."""
     is_stack = header["format"] == "scalable_stack"
     if expect_scalable is not None and is_stack != expect_scalable:
         raise ValueError(
@@ -415,15 +613,26 @@ class AsyncCheckpointer:
     periodic checkpointing with bounded tail loss on crash).
     """
 
-    def __init__(self, filter_obj, sink, *, every_n_inserts: int = 0, meta_fn=None):
+    def __init__(
+        self,
+        filter_obj,
+        sink,
+        *,
+        every_n_inserts: int = 0,
+        meta_fn=None,
+        retain: int = DEFAULT_RETAIN,
+    ):
         """``meta_fn() -> dict`` (optional) is sampled at trigger time and
         stored in the checkpoint header's ``extra`` field — the streaming
         pipeline records its stream offset this way so resume knows where
-        to replay from."""
+        to replay from. ``retain`` bounds how many generations the sink
+        keeps (GC runs after each successful write, on sinks with
+        ``prune``); 0 disables GC."""
         self.filter = filter_obj
         self.sink = sink
         self.every_n_inserts = every_n_inserts
         self.meta_fn = meta_fn
+        self.retain = retain
         self._since_last = 0
         # Millisecond-epoch base keeps sequence numbers monotonic across
         # process restarts (restore picks the max seq in the sink).
@@ -455,6 +664,14 @@ class AsyncCheckpointer:
                 self.last_checkpoint_time = time.time()
                 self.last_checkpoint_duration_s = time.perf_counter() - t0
                 self.last_error = None  # a success clears a transient failure
+                if self.retain and hasattr(self.sink, "prune"):
+                    # GC AFTER a confirmed-good write: the newest file is
+                    # intact, so dropping generations beyond `retain`
+                    # never strips the corruption fallback
+                    try:
+                        self.sink.prune(key_name, keep=self.retain)
+                    except Exception:  # GC failure must not fail the write
+                        log.exception("checkpoint GC for %r failed", key_name)
             except Exception as e:  # surfaced via last_error + health checks
                 self.last_error = e
             finally:

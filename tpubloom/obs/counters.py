@@ -15,6 +15,7 @@ from collections import defaultdict
 
 _lock = threading.Lock()
 _counters: dict[str, int] = defaultdict(int)
+_gauges: dict[str, float] = {}
 
 
 def incr(name: str, n: int = 1) -> None:
@@ -33,7 +34,26 @@ def global_counters() -> dict[str, int]:
         return dict(_counters)
 
 
+def set_gauge(name: str, value: float) -> None:
+    """Process-global gauge (last-write-wins): library-level state that is
+    a level, not an event — e.g. the client circuit-breaker state."""
+    with _lock:
+        _gauges[name] = value
+
+
+def get_gauge(name: str, default: float = 0.0) -> float:
+    with _lock:
+        return _gauges.get(name, default)
+
+
+def global_gauges() -> dict[str, float]:
+    """Snapshot copy of all process-global gauges."""
+    with _lock:
+        return dict(_gauges)
+
+
 def reset_for_tests() -> None:
     """Zero everything — test isolation only."""
     with _lock:
         _counters.clear()
+        _gauges.clear()

@@ -25,7 +25,11 @@ Metric catalog (all prefixed ``tpubloom_``):
   ``checkpoints_written_total`` — checkpoint gauges, label ``{filter}``.
 * ``slowlog_entries`` / ``slowlog_recorded_total`` — slowlog state.
 * ``uptime_seconds``, plus every process-global counter (e.g.
-  ``geometry_probe_demotions_total``).
+  ``geometry_probe_demotions_total``, ``faults_injected_total``,
+  ``ckpt_corrupt_detected_total``) and every process-global gauge
+  (e.g. ``client_breaker_state``: 0 closed / 1 half-open / 2 open).
+* robustness counters (ISSUE 2): ``requests_shed_total``,
+  ``delete_dedup_hits_total``, ``restores_with_corrupt_generations_total``.
 """
 
 from __future__ import annotations
@@ -163,6 +167,11 @@ def render_service(service) -> str:
     for name in sorted(process_counters):
         _header(out, f"{name}_total", "counter", f"Process counter {name}")
         out.append(_line(f"{name}_total", process_counters[name]))
+
+    process_gauges = _global.global_gauges()
+    for name in sorted(process_gauges):
+        _header(out, name, "gauge", f"Process gauge {name}")
+        out.append(_line(name, process_gauges[name]))
 
     bounds = met["bucket_bounds_us"]
     _render_histogram(

@@ -10,14 +10,27 @@ and leaves retry to the caller, the new framework does better):
 
 * ``UNAVAILABLE`` (server down / restarting) is retried with exponential
   backoff + jitter. Safe because every retried op is idempotent — bloom
-  insert/query/clear/checkpoint can be replayed freely. The one exception
-  is ``delete_batch``: a counting-filter delete that *did* land would be
-  applied twice on replay (double-decrement → false negatives), so it is
-  never auto-retried.
+  insert/query/clear/checkpoint can be replayed freely. ``delete_batch``
+  (a counting-filter counter decrement) is retryable too since ISSUE 2:
+  retries reuse the logical call's rid and the server keeps a bounded
+  rid→response dedup cache, so a replayed delete that already landed is
+  answered from cache instead of double-decrementing.
+* ``RESOURCE_EXHAUSTED`` / ``DRAINING`` (overload shed / graceful roll)
+  are retried for EVERY method — a shed happens before the handler runs,
+  so nothing was applied — pacing off the server's ``retry_after_ms``
+  hint when it beats local backoff.
 * ``NOT_FOUND`` after a server restart (the new process has not seen the
   filter yet) is healed transparently: the client replays the original
   ``create_filter`` request with ``exist_ok=True, restore=True`` — the
   server restores the newest checkpoint — then retries the op once.
+* A **circuit breaker** guards the whole channel: after
+  ``breaker_threshold`` consecutive *logical* transport failures (a call
+  that exhausted its UNAVAILABLE retries), calls fail fast with
+  ``CIRCUIT_OPEN`` for ``breaker_cooldown`` seconds instead of stacking
+  more backoff on a dead server; one half-open probe then decides
+  between closing and re-opening. Breaker state is exported as the
+  process gauge ``client_breaker_state`` (0 closed / 1 half-open /
+  2 open).
 
 Observability: every RPC is stamped with a generated request id
 (``self.last_rid`` after the call) which the server folds into its
@@ -29,17 +42,112 @@ Retries of one logical call share the rid.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Optional, Sequence
 
 import grpc
 import numpy as np
 
+from tpubloom.obs import counters as obs_counters
 from tpubloom.obs.context import new_rid
 from tpubloom.server import protocol
 
-# delete is always a counting-filter counter decrement — never idempotent
-_NO_RETRY = frozenset({"DeleteBatch"})
+#: error codes meaning "the server refused BEFORE running the handler" —
+#: replaying is safe for every method, idempotent or not
+_SHED_CODES = frozenset({"RESOURCE_EXHAUSTED", "DRAINING"})
+
+_BREAKER_GAUGE = {"closed": 0, "half-open": 1, "open": 2}
+
+
+class CircuitOpenError(protocol.BloomServiceError):
+    """Raised without touching the network while the breaker is open."""
+
+    def __init__(self, address: str, cooldown_left: float):
+        super().__init__(
+            "CIRCUIT_OPEN",
+            f"circuit to {address} is open for another "
+            f"{cooldown_left:.2f}s after consecutive transport failures",
+        )
+
+
+class CircuitBreaker:
+    """Per-channel fail-fast: K consecutive logical transport failures
+    open the circuit for a cooldown; one half-open probe then decides.
+
+    Counts *logical* calls (after each call's own UNAVAILABLE backoff is
+    exhausted), not raw attempts — a single patient call riding out a
+    restart must not trip the breaker. ``threshold=0`` disables."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 5.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self._consecutive = 0
+        self._state = "closed"
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self._lock = threading.Lock()
+        obs_counters.set_gauge("client_breaker_state", 0)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        obs_counters.set_gauge("client_breaker_state", _BREAKER_GAUGE[state])
+
+    def check(self, address: str) -> None:
+        """Raise :class:`CircuitOpenError` while open; transition to
+        half-open (admitting exactly this one probe) once the cooldown
+        has elapsed."""
+        if not self.threshold:
+            return
+        with self._lock:
+            if self._state == "closed":
+                return
+            now = time.monotonic()
+            if self._state == "open":
+                elapsed = now - self._opened_at
+                if elapsed >= self.cooldown:
+                    self._set_state("half-open")
+                    self._half_open_at = now
+                    return  # this caller is the probe
+                raise CircuitOpenError(address, self.cooldown - elapsed)
+            # half-open: one probe at a time — but a probe that vanished
+            # without reaching record_* (interrupt, encode error) must not
+            # wedge the breaker forever, so a stale probe slot reopens
+            # after another cooldown
+            elapsed = now - self._half_open_at
+            if elapsed >= self.cooldown:
+                self._half_open_at = now
+                return
+            raise CircuitOpenError(address, self.cooldown - elapsed)
+
+    def record_success(self) -> None:
+        if not self.threshold:
+            return
+        with self._lock:
+            self._consecutive = 0
+            if self._state != "closed":
+                self._set_state("closed")
+                obs_counters.incr("breaker_closed")
+
+    def record_failure(self) -> None:
+        if not self.threshold:
+            return
+        with self._lock:
+            self._consecutive += 1
+            tripped = (
+                self._state == "half-open"
+                or (self._state == "closed"
+                    and self._consecutive >= self.threshold)
+            )
+            if tripped:
+                self._set_state("open")
+                self._opened_at = time.monotonic()
+                obs_counters.incr("breaker_opened")
 
 
 class BloomClient:
@@ -53,12 +161,15 @@ class BloomClient:
         max_retries: int = 5,
         backoff_base: float = 0.2,
         backoff_max: float = 5.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 5.0,
     ):
         self.address = address
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
         self.last_rid: Optional[str] = None
         self._creations: dict[str, dict] = {}
         self._channel = grpc.insecure_channel(
@@ -100,30 +211,39 @@ class BloomClient:
         )
 
     def _rpc(self, method: str, req: dict, *, force_no_retry: bool = False) -> dict:
+        # fail fast while the breaker is open — no network, no backoff
+        self.breaker.check(self.address)
         # request-correlation id: one per LOGICAL call (retries and the
         # NOT_FOUND heal's final retry share it); exposed as last_rid so
-        # callers can find their request in the server slowlog/trace
+        # callers can find their request in the server slowlog/trace.
+        # DeleteBatch retries lean on this id: the server's dedup cache
+        # answers a replayed rid from cache instead of re-applying.
         self.last_rid = rid = new_rid()
         req = {**req, "rid": rid}
         # Counting-filter inserts are scatter-ADDs, not idempotent OR —
         # a replayed insert that DID land double-increments counters, so a
-        # later delete leaves residue (stuck false positives). Same reason
-        # DeleteBatch is never retried.
-        no_retry = force_no_retry or method in _NO_RETRY or (
+        # later delete leaves residue (stuck false positives).
+        no_retry = force_no_retry or (
             method == "InsertBatch"
             and self._maybe_nonidempotent_insert(req.get("name", ""))
         )
         retries = 0 if no_retry else self.max_retries
         recreated = False
         attempt = 0
+        shed_attempt = 0
         while True:
             try:
-                return self._call_once(method, req)
+                resp = self._call_once(method, req)
+                self.breaker.record_success()
+                return resp
             except grpc.RpcError as e:
                 if (
                     e.code() is not grpc.StatusCode.UNAVAILABLE
                     or attempt >= retries
                 ):
+                    # one LOGICAL failure (own retries exhausted) = one
+                    # breaker strike — patient riders don't trip it
+                    self.breaker.record_failure()
                     raise
                 delay = min(
                     self.backoff_max, self.backoff_base * (2 ** attempt)
@@ -131,6 +251,24 @@ class BloomClient:
                 time.sleep(delay)
                 attempt += 1
             except protocol.BloomServiceError as e:
+                # an application-level answer means the transport is fine
+                self.breaker.record_success()
+                if e.code in _SHED_CODES:
+                    # shed BEFORE execution — safe to replay any method,
+                    # even the non-idempotent ones; pace off the server's
+                    # hint when it beats local backoff
+                    if shed_attempt >= self.max_retries:
+                        raise
+                    delay = min(
+                        self.backoff_max,
+                        self.backoff_base * (2 ** shed_attempt),
+                    )
+                    hint_ms = e.details.get("retry_after_ms")
+                    if hint_ms:
+                        delay = max(delay, hint_ms / 1000.0)
+                    time.sleep(delay * (0.75 + random.random() / 2))
+                    shed_attempt += 1
+                    continue
                 # Heal a restarted server: replay the remembered creation
                 # (restores the newest checkpoint), then retry the op once.
                 creation = self._creations.get(req.get("name", ""))
@@ -155,9 +293,43 @@ class BloomClient:
     def health(self) -> dict:
         return self._rpc("Health", {})
 
-    def wait_ready(self, timeout: float = 30.0) -> dict:
+    def wait_ready(
+        self,
+        timeout: float = 30.0,
+        poll: float = 0.1,
+        *,
+        accept_degraded: bool = True,
+    ) -> dict:
+        """Block until the server is actually serving, not merely until the
+        channel connects: the gRPC channel comes up before restore-on-create
+        and warm-up finish, so callers racing the service would see
+        NOT_FOUND churn. Polls the Health RPC until it reports ``SERVING``
+        — or ``DEGRADED`` too by default, since a degraded server (e.g. it
+        quarantined a corrupt checkpoint on restore) IS serving and may
+        stay degraded until its next good checkpoint; pass
+        ``accept_degraded=False`` to insist on fully healthy. Servers
+        predating the status field count as SERVING. Returns the final
+        health response; raises TimeoutError otherwise."""
+        ready = {"SERVING", "DEGRADED"} if accept_degraded else {"SERVING"}
+        deadline = time.monotonic() + timeout
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
-        return self.health()
+        last: object = None
+        while True:
+            try:
+                h = self.health()
+                if h.get("status", "SERVING") in ready:
+                    return h
+                last = h
+            except (grpc.RpcError, protocol.BloomServiceError) as e:
+                # includes CircuitOpenError: keep polling until the
+                # breaker's cooldown lets the next probe through
+                last = e
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"server at {self.address} not ready within "
+                    f"{timeout}s (last: {last!r})"
+                )
+            time.sleep(poll)
 
     def create_filter(
         self,
@@ -278,6 +450,9 @@ class BloomClient:
         return self._unpack_bool(resp, "hits")
 
     def delete_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
+        """Counting-filter delete. Auto-retried like any other op: retries
+        reuse the call's rid and the server's dedup cache answers a replay
+        whose first attempt already landed, so no double-decrement."""
         return self._rpc("DeleteBatch", {"name": name, "keys": self._keys(keys)})["n"]
 
     def insert(self, name: str, key: bytes | str) -> None:

@@ -55,20 +55,32 @@ def method_path(method: str) -> str:
     return f"/{SERVICE}/{method}"
 
 
-def error_response(code: str, message: str) -> dict:
-    return {"ok": False, "error": {"code": code, "message": message}}
+def error_response(code: str, message: str, details: dict | None = None) -> dict:
+    """``details`` carries structured, machine-readable error context —
+    e.g. overload sheds (``RESOURCE_EXHAUSTED``/``DRAINING``) include
+    ``retry_after_ms`` so clients pace their retries instead of
+    hammering."""
+    err: dict = {"code": code, "message": message}
+    if details:
+        err["details"] = details
+    return {"ok": False, "error": err}
 
 
 def check(resp: dict) -> dict:
     """Client-side: raise on an error response, else return it."""
     if not resp.get("ok", False):
         err = resp.get("error", {})
-        raise BloomServiceError(err.get("code", "UNKNOWN"), err.get("message", ""))
+        raise BloomServiceError(
+            err.get("code", "UNKNOWN"),
+            err.get("message", ""),
+            err.get("details") or {},
+        )
     return resp
 
 
 class BloomServiceError(RuntimeError):
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str, details: dict | None = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
+        self.details = details or {}

@@ -19,6 +19,26 @@ Runtime properties:
 * health + stats RPCs (gRPC health-check parity, SURVEY.md §5 failure row);
 * graceful restart: on startup every configured filter restores its newest
   checkpoint.
+
+Robustness (ISSUE 2):
+
+* **overload shedding** — ``max_in_flight`` caps concurrently-executing
+  data-plane RPCs; excess requests are rejected *before decode* with
+  ``RESOURCE_EXHAUSTED`` + ``retry_after_ms`` instead of queueing toward
+  OOM. ``Health`` (and the other cheap control-plane reads) never sheds,
+  so the overload state stays observable;
+* **health states** — ``Health`` reports ``SERVING`` / ``DEGRADED``
+  (checkpoint write errors, corrupt checkpoint seen at restore, recent
+  shedding) / ``DRAINING``, with machine-readable reasons;
+* **graceful drain** — on SIGTERM the server stops admitting work
+  (``DRAINING`` sheds), lets in-flight RPCs finish, takes a final
+  checkpoint of every dirty filter, then exits;
+* **retryable DeleteBatch** — a bounded rid→response dedup cache answers
+  a replayed counting-filter delete from cache instead of
+  double-decrementing (client retries reuse the logical call's rid);
+* **fault points** — ``rpc.pre_handle`` / ``rpc.post_handle``
+  (:mod:`tpubloom.faults`) let the chaos suite simulate handler crashes
+  and response-lost-after-apply without patching internals.
 """
 
 from __future__ import annotations
@@ -26,6 +46,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from concurrent import futures
 from typing import Optional
 
@@ -33,6 +54,8 @@ import grpc
 import numpy as np
 
 from tpubloom import checkpoint as ckpt
+from tpubloom import faults
+from tpubloom.obs import counters as obs_counters
 from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.obs import context as obs
@@ -62,17 +85,53 @@ class _Managed:
         )
 
 
+#: RPCs that are never shed: Health must answer DURING overload or the
+#: operator flies blind, and the rest are cheap in-memory control-plane
+#: reads that hold no device buffers.
+UNSHEDDABLE = frozenset(
+    {"Health", "ListFilters", "SlowlogGet", "SlowlogReset"}
+)
+
+#: How long after the last shed Health keeps reporting the "shedding"
+#: degraded reason — long enough for a scraper/prober to catch a burst.
+SHED_DEGRADED_WINDOW_S = 5.0
+
+
 class BloomService:
     """Method handlers; state = {name: _Managed}."""
 
-    def __init__(self, sink_factory=None, *, slowlog_capacity: int = 128):
+    def __init__(
+        self,
+        sink_factory=None,
+        *,
+        slowlog_capacity: int = 128,
+        max_in_flight: Optional[int] = None,
+        retry_after_ms: int = 50,
+        dedup_capacity: int = 1024,
+    ):
         """``sink_factory(config) -> sink|None`` decides where each filter
-        checkpoints (None disables persistence for that filter)."""
+        checkpoints (None disables persistence for that filter).
+        ``max_in_flight`` caps concurrently-executing sheddable RPCs
+        (None/0 = unbounded); shed responses carry ``retry_after_ms``.
+        ``dedup_capacity`` bounds the rid→response replay cache that makes
+        DeleteBatch safely retryable (0 disables it)."""
         self._filters: dict[str, _Managed] = {}
         self._lock = threading.Lock()
         self._sink_factory = sink_factory or (lambda config: None)
         self.metrics = Metrics()
         self.slowlog = Slowlog(capacity=slowlog_capacity)
+        self.max_in_flight = max_in_flight
+        self.retry_after_ms = retry_after_ms
+        self._in_flight = 0
+        self._admit_lock = threading.Lock()
+        self._draining = False
+        self._last_shed_time = 0.0
+        self._dedup_capacity = dedup_capacity
+        self._dedup: "OrderedDict[str, dict]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        #: filter name -> time a corrupt checkpoint was detected during its
+        #: restore; cleared once a good checkpoint lands after that moment
+        self._ckpt_corrupt_seen: dict[str, float] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -84,16 +143,94 @@ class BloomService:
             )
         return mf
 
+    # -- admission control (overload shedding + drain) -----------------------
+
+    def admit(self, method: str) -> Optional[dict]:
+        """Admission decision for one RPC, taken BEFORE the request is even
+        decoded (a shed must cost microseconds, not a msgpack parse).
+
+        Returns None when admitted — the caller MUST pair it with
+        :meth:`release` — or a ready-to-encode error response when the
+        request is shed (draining, or the in-flight cap is hit)."""
+        if method in UNSHEDDABLE:
+            return None
+        with self._admit_lock:
+            if self._draining:
+                shed_code, shed_msg = "DRAINING", "server is draining"
+            elif self.max_in_flight and self._in_flight >= self.max_in_flight:
+                shed_code = "RESOURCE_EXHAUSTED"
+                shed_msg = (
+                    f"in-flight cap {self.max_in_flight} reached; retry with "
+                    f"backoff"
+                )
+            else:
+                self._in_flight += 1
+                return None
+            self._last_shed_time = time.time()
+        self.metrics.count("requests_shed")
+        return protocol.error_response(
+            shed_code, shed_msg, details={"retry_after_ms": self.retry_after_ms}
+        )
+
+    def release(self, method: str) -> None:
+        if method in UNSHEDDABLE:
+            return
+        with self._admit_lock:
+            self._in_flight -= 1
+
+    def begin_drain(self) -> None:
+        """Stop admitting data-plane work (Health keeps answering, now
+        reporting DRAINING); in-flight requests run to completion."""
+        with self._admit_lock:
+            self._draining = True
+
     # -- RPC handlers (dict in, dict out) ------------------------------------
+
+    def _health_reasons(self) -> list:
+        """Machine-readable degraded reasons (empty = healthy)."""
+        reasons = []
+        with self._lock:
+            filters = list(self._filters.items())
+        for name, mf in filters:
+            if mf.checkpointer is None:
+                self._ckpt_corrupt_seen.pop(name, None)
+                continue
+            if mf.checkpointer.last_error is not None:
+                reasons.append(f"checkpoint_error:{name}")
+            seen = self._ckpt_corrupt_seen.get(name)
+            if seen is not None:
+                landed = mf.checkpointer.last_checkpoint_time
+                if landed is not None and landed > seen:
+                    # a good generation has been written since the corrupt
+                    # one was quarantined — the degradation is over
+                    self._ckpt_corrupt_seen.pop(name, None)
+                else:
+                    reasons.append(f"checkpoint_corrupt:{name}")
+        if time.time() - self._last_shed_time < SHED_DEGRADED_WINDOW_S:
+            reasons.append("shedding")
+        return reasons
 
     def Health(self, req: dict) -> dict:
         import jax
 
+        reasons = self._health_reasons()
+        if self._draining:
+            status = "DRAINING"
+        elif reasons:
+            status = "DEGRADED"
+        else:
+            status = "SERVING"
+        with self._admit_lock:
+            in_flight = self._in_flight
         return {
             "ok": True,
+            "status": status,
+            "reasons": reasons,
             "backend": jax.default_backend(),
             "devices": [str(d) for d in jax.devices()],
             "filters": len(self._filters),
+            "in_flight": in_flight,
+            "max_in_flight": self.max_in_flight,
         }
 
     @staticmethod
@@ -139,6 +276,17 @@ class BloomService:
             "growth": filt.growth,
             "tightening": filt.tightening,
         }
+
+    def _tracked_restore(self, name: str, config, sink, **kwargs):
+        """checkpoint.restore, but remember when the walk had to skip
+        corrupt generations for this filter — Health reports the filter
+        DEGRADED until a good checkpoint lands after that moment."""
+        before = obs_counters.get("ckpt_corrupt_detected")
+        restored = ckpt.restore(config, sink, **kwargs)
+        if obs_counters.get("ckpt_corrupt_detected") > before:
+            self._ckpt_corrupt_seen[name] = time.time()
+            self.metrics.count("restores_with_corrupt_generations")
+        return restored
 
     def CreateFilter(self, req: dict) -> dict:
         name = req["name"]
@@ -229,7 +377,9 @@ class BloomService:
             restored = None
             if sink is not None and req.get("restore", True):
                 try:
-                    restored = ckpt.restore(config, sink, expect_scalable=False)
+                    restored = self._tracked_restore(
+                        name, config, sink, expect_scalable=False
+                    )
                 except ValueError as e:
                     raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
             if restored is not None:
@@ -278,8 +428,9 @@ class BloomService:
         restored = None
         if sink is not None and req.get("restore", True):
             try:
-                restored = ckpt.restore(
-                    base, sink, scalable_expect=policy, expect_scalable=True
+                restored = self._tracked_restore(
+                    name, base, sink,
+                    scalable_expect=policy, expect_scalable=True,
                 )
             except ValueError as e:
                 raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
@@ -365,6 +516,24 @@ class BloomService:
             packed = np.packbits(hits).tobytes()
         return {"ok": True, "hits": packed, "n": len(req["keys"])}
 
+    def _dedup_get(self, rid) -> Optional[dict]:
+        if not rid or not self._dedup_capacity:
+            return None
+        with self._dedup_lock:
+            resp = self._dedup.get(rid)
+            if resp is not None:
+                self._dedup.move_to_end(rid)
+        return resp
+
+    def _dedup_put(self, rid, resp: dict) -> None:
+        if not rid or not self._dedup_capacity:
+            return
+        with self._dedup_lock:
+            self._dedup[rid] = resp
+            self._dedup.move_to_end(rid)
+            while len(self._dedup) > self._dedup_capacity:
+                self._dedup.popitem(last=False)
+
     def DeleteBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
         # attribute presence is not the signal (ShardedBloomFilter carries
@@ -376,10 +545,24 @@ class BloomService:
             raise protocol.BloomServiceError(
                 "UNSUPPORTED", "delete requires a counting filter"
             )
+        # Retry safety (ISSUE 2 satellite): a delete is a counter
+        # DECREMENT — a replay of one that already landed would decrement
+        # twice (-> false negatives). Client retries reuse the logical
+        # call's rid, so a bounded rid->response cache turns the replay
+        # into a cache hit instead of a second apply. (Retries from one
+        # client are sequential, so the lookup/apply pair doesn't need to
+        # be atomic across requests.)
+        rid = req.get("rid")
+        cached = self._dedup_get(rid)
+        if cached is not None:
+            self.metrics.count("delete_dedup_hits")
+            return cached
         with mf.lock:
             mf.filter.delete_batch(req["keys"])
         self.metrics.count("keys_deleted", len(req["keys"]))
-        return {"ok": True, "n": len(req["keys"])}
+        resp = {"ok": True, "n": len(req["keys"])}
+        self._dedup_put(rid, resp)
+        return resp
 
     def Clear(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -470,6 +653,10 @@ class BloomService:
         return {"ok": True, "triggered": triggered, "seq": mf.checkpointer.seq}
 
     def shutdown(self) -> None:
+        """Final checkpoint of every managed filter. Callers doing a full
+        graceful drain should ``begin_drain()`` + stop the gRPC server
+        first so no insert races the final snapshots."""
+        self.begin_drain()
         with self._lock:
             filters = list(self._filters.items())
         for name, mf in filters:
@@ -489,24 +676,37 @@ def _wrap(service: BloomService, method_name: str):
     def unary_unary(request: bytes, context) -> bytes:
         t0 = time.perf_counter()
         with obs.request(method_name) as rctx:
-            try:
-                with obs.phase("decode"):
-                    req = protocol.decode(request)
-                # correlate with the client's id when it sent one; the
-                # context pre-generated a server-side id otherwise
-                if isinstance(req.get("rid"), str) and req["rid"]:
-                    rctx.rid = req["rid"]
-                keys = req.get("keys")
-                rctx.batch = len(keys) if isinstance(keys, list) else 0
-                rctx.summary = summarize_request(method_name, req)
-                resp = handler(req)
-            except protocol.BloomServiceError as e:
-                resp = protocol.error_response(e.code, e.message)
-            except Exception as e:  # surface, don't kill the channel
-                log.exception("RPC %s failed", method_name)
-                resp = protocol.error_response(
-                    "INTERNAL", f"{type(e).__name__}: {e}"
-                )
+            # admission first, before decode: a shed must stay cheap when
+            # the server is drowning (that is the whole point of the cap)
+            shed = service.admit(method_name)
+            if shed is not None:
+                resp = shed
+                rctx.summary = "(shed)"
+            else:
+                try:
+                    faults.fire("rpc.pre_handle")
+                    with obs.phase("decode"):
+                        req = protocol.decode(request)
+                    # correlate with the client's id when it sent one; the
+                    # context pre-generated a server-side id otherwise
+                    if isinstance(req.get("rid"), str) and req["rid"]:
+                        rctx.rid = req["rid"]
+                    keys = req.get("keys")
+                    rctx.batch = len(keys) if isinstance(keys, list) else 0
+                    rctx.summary = summarize_request(method_name, req)
+                    resp = handler(req)
+                    # post-apply fault: the handler's effect landed but the
+                    # response is "lost" — the case rid-dedup must absorb
+                    faults.fire("rpc.post_handle")
+                except protocol.BloomServiceError as e:
+                    resp = protocol.error_response(e.code, e.message, e.details)
+                except Exception as e:  # surface, don't kill the channel
+                    log.exception("RPC %s failed", method_name)
+                    resp = protocol.error_response(
+                        "INTERNAL", f"{type(e).__name__}: {e}"
+                    )
+                finally:
+                    service.release(method_name)
             try:
                 with obs.phase("encode"):
                     raw = protocol.encode(resp)
@@ -559,8 +759,10 @@ def build_server(
 
 def main(argv: Optional[list] = None) -> None:
     """``python -m tpubloom.server [port] [checkpoint_dir]
-    [--metrics-port N] [--slowlog-capacity N]``"""
+    [--metrics-port N] [--slowlog-capacity N] [--max-in-flight N]
+    [--drain-grace S]``"""
     import argparse
+    import signal
 
     parser = argparse.ArgumentParser(
         prog="tpubloom.server", description="tpubloom gRPC server"
@@ -580,14 +782,34 @@ def main(argv: Optional[list] = None) -> None:
         default=128,
         help="how many slowest requests SlowlogGet retains (default 128)",
     )
+    parser.add_argument(
+        "--max-in-flight",
+        type=int,
+        default=None,
+        help="cap on concurrently-executing data-plane RPCs; excess "
+        "requests are shed with RESOURCE_EXHAUSTED + retry_after_ms "
+        "(default: unbounded)",
+    )
+    parser.add_argument(
+        "--drain-grace",
+        type=float,
+        default=15.0,
+        help="seconds to let in-flight RPCs finish on SIGTERM/SIGINT "
+        "before final checkpoints (default 15)",
+    )
     args = parser.parse_args(argv)
     ckpt_dir = args.checkpoint_dir
     sink_factory = (
         (lambda config: ckpt.FileSink(ckpt_dir)) if ckpt_dir else (lambda config: None)
     )
     logging.basicConfig(level=logging.INFO)
+    faults.load_env()
+    for armed in faults.active():
+        log.warning("fault injection armed: %s", armed)
     service = BloomService(
-        sink_factory=sink_factory, slowlog_capacity=args.slowlog_capacity
+        sink_factory=sink_factory,
+        slowlog_capacity=args.slowlog_capacity,
+        max_in_flight=args.max_in_flight,
     )
     server, bound = build_server(service, f"0.0.0.0:{args.port}")
     server.start()
@@ -601,11 +823,27 @@ def main(argv: Optional[list] = None) -> None:
             "prometheus exposition on http://0.0.0.0:%d/metrics",
             metrics_server.port,
         )
-    try:
-        server.wait_for_termination()
-    except KeyboardInterrupt:
-        log.info("shutting down: final checkpoints...")
-        service.shutdown()
-        server.stop(grace=5)
-        if metrics_server is not None:
-            metrics_server.close()
+
+    # Graceful drain (ISSUE 2): SIGTERM/SIGINT -> stop admitting (new
+    # requests shed as DRAINING; clients pace off retry_after_ms and find
+    # the replacement process), finish in-flight work, write a final
+    # checkpoint of every filter, then exit. Acked-but-unflushed state
+    # survives the roll.
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda signum, frame: stop.set())
+    stop.wait()
+    log.info("drain: refusing new work, finishing in-flight requests...")
+    service.begin_drain()
+    # Notice window BEFORE the port closes: grpc's stop() rejects new RPCs
+    # at the transport, so without this pause clients would only ever see
+    # raw UNAVAILABLE — never the structured DRAINING shed (with
+    # retry_after_ms) or a DRAINING Health answer that tells them this is
+    # a roll, not an outage.
+    time.sleep(min(2.0, args.drain_grace / 3))
+    server.stop(grace=args.drain_grace).wait()
+    log.info("drain: final checkpoints...")
+    service.shutdown()
+    if metrics_server is not None:
+        metrics_server.close()
+    log.info("drain complete; exiting")

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Replication smoke: boot a primary + read replica on CPU and assert
+the ISSUE-3 surface end to end (fast: small filters, ephemeral ports).
+
+What it drives:
+
+* a primary with an op log (``--repl-log-dir`` equivalent) takes writes
+  into a **counting** filter (counts exactly 1 per key — the
+  double-apply litmus);
+* a read-only replica full-resyncs over ``ReplStream``, catches up to
+  ``repl_lag_seq == 0``, and answers ``QueryBatch`` with membership
+  identical to the primary; a write against it gets ``READONLY``;
+* an injected ``repl.stream_send`` fault kills the stream mid-batch;
+  the replica reconnects (partial resync) and the counting counts prove
+  **zero double-applies** — one delete round empties every key;
+* a ``Monitor`` subscription sees live ops (MONITOR parity);
+* the primary restarts and replays its op log over the (absent)
+  checkpoints — AOF parity: acked writes survive.
+
+Run directly (``python benchmarks/repl_smoke.py`` — prints one JSON
+line) or via tier-1 (``tests/test_repl.py::test_repl_smoke``). CI runs
+both paths so the replication hooks cannot rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def run_smoke() -> dict:
+    """Drive the replication scenario; returns summary facts (raises on
+    any failure)."""
+    import numpy as np
+
+    from tpubloom import checkpoint as ckpt
+    from tpubloom import faults
+    from tpubloom.repl import OpLog
+    from tpubloom.repl.replica import ReplicaApplier
+    from tpubloom.server.client import BloomClient
+    from tpubloom.server.protocol import BloomServiceError
+    from tpubloom.server.service import BloomService, build_server
+
+    faults.reset()
+    out: dict = {}
+    ckpt_dir = tempfile.mkdtemp(prefix="tpubloom-repl-smoke-ckpt-")
+    log_dir = tempfile.mkdtemp(prefix="tpubloom-repl-smoke-log-")
+    cleanup: list = []  # run LIFO even on assert failure — a leaked grpc
+    # server's non-daemon threads would hang the process at exit
+
+    try:
+        # -- primary + replica -----------------------------------------------
+        oplog = OpLog(log_dir)
+        cleanup.append(oplog.close)
+        psvc = BloomService(
+            sink_factory=lambda config: ckpt.FileSink(ckpt_dir), oplog=oplog
+        )
+        psrv, pport = build_server(psvc, "127.0.0.1:0")
+        psrv.start()
+        cleanup.append(lambda: psrv.stop(grace=None))
+        pc = BloomClient(f"127.0.0.1:{pport}")
+        cleanup.append(pc.close)
+        pc.wait_ready()
+        rng = np.random.default_rng(0)
+        keys = [rng.bytes(16) for _ in range(1000)]
+        pc.create_filter(
+            "smoke", capacity=50_000, error_rate=0.01, counting=True
+        )
+        pc.insert_batch("smoke", keys)  # every count exactly 1
+
+        rsvc = BloomService(read_only=True)
+        rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+        rsrv.start()
+        cleanup.append(lambda: rsrv.stop(grace=None))
+        applier = ReplicaApplier(
+            rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+        ).start()
+        cleanup.append(applier.stop)
+        rc = BloomClient(f"127.0.0.1:{rport}")
+        cleanup.append(rc.close)
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        out["replica_caught_up"] = True
+        out["full_syncs"] = applier.full_syncs
+
+        assert rc.include_batch("smoke", keys).all(), "replica lost members"
+        absent = [rng.bytes(16) for _ in range(1000)]
+        assert (
+            rc.include_batch("smoke", absent)
+            == pc.include_batch("smoke", absent)
+        ).all(), "replica membership diverged from primary"
+        # raw call: the stock client would transparently FOLLOW the
+        # READONLY redirect to the primary (a feature — but here the
+        # rejection itself is under test)
+        try:
+            rc._call_once(
+                "InsertBatch", {"name": "smoke", "keys": [b"nope"]}
+            )
+            raise AssertionError("replica accepted a write")
+        except BloomServiceError as e:
+            assert e.code == "READONLY", e
+        out["readonly_enforced"] = True
+
+        # -- monitor parity --------------------------------------------------
+        mon = pc.monitor("smoke")
+        cleanup.append(mon.cancel)
+        mon_iter = iter(mon)
+        assert next(mon_iter)["kind"] == "hello"
+
+        # -- kill the stream mid-batch, prove exactly-once -------------------
+        faults.arm("repl.stream_send", "once")
+        pc.insert_batch("smoke", [rng.bytes(16) for _ in range(200)])
+        deadline = time.monotonic() + 30
+        while applier.partial_syncs == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert applier.partial_syncs >= 1, (
+            f"stream never reconnected: {applier.status()}"
+        )
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        pc.delete_batch("smoke", keys)  # 1 - 1 = 0 ... unless double-applied
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        double_applied = int(rc.include_batch("smoke", keys).sum())
+        assert double_applied == 0, f"{double_applied} keys double-applied"
+        out["double_applied"] = 0
+        out["partial_syncs"] = applier.partial_syncs
+        out["records_applied"] = applier.records_applied
+
+        mon_events = 0
+        for msg in mon_iter:
+            if msg["kind"] == "op":
+                mon_events += 1
+            if mon_events >= 1:
+                break
+        out["monitor_events"] = mon_events
+    finally:
+        for fn in reversed(cleanup):
+            try:
+                fn()
+            except Exception:
+                pass
+
+    # -- AOF parity: restart the primary from log alone ----------------------
+    oplog2 = OpLog(log_dir)
+    psvc2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(ckpt_dir), oplog=oplog2
+    )
+    stats = psvc2.replay_oplog()
+    assert stats["failed"] == 0, stats
+    hits = psvc2.QueryBatch({"name": "smoke", "keys": keys})
+    survivors = int(
+        np.unpackbits(np.frombuffer(hits["hits"], np.uint8), count=hits["n"]).sum()
+    )
+    assert survivors == 0, (
+        f"replayed deletes lost: {survivors} keys resurrected"
+    )
+    out["replayed"] = stats
+    psvc2.shutdown()
+    oplog2.close()
+    return out
+
+
+def main() -> int:
+    if os.environ.get("JAX_PLATFORMS") is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # runnable as `python benchmarks/repl_smoke.py` from a checkout
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    result = run_smoke()
+    print(json.dumps({"ok": True, **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,16 +80,23 @@ def test_shards_mismatch_rejected(tmp_path):
         ckpt.restore(cfg.replace(shards=4), sink)
 
 
-def test_redis_sink_rejects_old_seq(cfg):
+def test_redis_sink_multi_generation_get(cfg):
+    """RedisSink retains generations (ISSUE 3 satellite — it used to
+    keep only the newest blob): an older retained seq restores, a seq
+    never written restores as None."""
     srv = FakeRedis()
     try:
         sink = ckpt.RedisSink("127.0.0.1", srv.port)
         f = BloomFilter(cfg)
         f.insert(b"x")
-        seq = ckpt.save(f, sink)
-        assert ckpt.restore(cfg, sink, seq=seq) is not None
-        with pytest.raises(ValueError, match="newest checkpoint"):
-            ckpt.restore(cfg, sink, seq=seq - 1)
+        seq_a = ckpt.save(f, sink)
+        f.insert(b"y")
+        seq_b = ckpt.save(f, sink, seq=seq_a + 1)
+        assert sink.list_seqs(cfg.key_name) == [seq_b, seq_a]
+        assert ckpt.restore(cfg, sink, seq=seq_b) is not None
+        old = ckpt.restore(cfg, sink, seq=seq_a)  # older generation: kept
+        assert old is not None and old._restored_seq == seq_a
+        assert ckpt.restore(cfg, sink, seq=seq_a - 1) is None  # never written
         sink.close()
     finally:
         srv.close()

@@ -411,8 +411,10 @@ def test_shed_surfaces_retry_after_ms(tmp_path):
             t.join()
         assert not errs
         assert sheds, "cap 2 with 6 concurrent slow queries must shed"
+        # adaptive since ISSUE 3: the hint starts at the configured base
+        # and grows with the shed rate — never below the base
         assert all(
-            e.details.get("retry_after_ms") == 37 for e in sheds
+            e.details.get("retry_after_ms", 0) >= 37 for e in sheds
         )
         assert len(oks) + len(sheds) == 6
         assert service.metrics.snapshot()["counters"]["requests_shed"] >= len(

@@ -1,0 +1,1062 @@
+"""Replication & changefeed suite (ISSUE 3).
+
+Layers covered:
+
+* record framing — CRC round-trip, torn-tail detection;
+* OpLog — append/replay, crash-recovery truncation, segment rolling +
+  checkpoint-keyed truncation;
+* server — op-log appends at commit points, startup replay over
+  restored checkpoints (AOF parity), checkpoint-seq gating (nothing
+  applies twice), READONLY rejection on replicas;
+* primary→replica streaming — full resync (snapshot + tail), live
+  tailing to ``repl_lag_seq == 0``, kill-the-stream-mid-batch chaos via
+  ``repl.stream_send`` with counting-filter exactly-once proof,
+  replica-side NOT_FOUND-free reads, MONITOR stream filtering;
+* client — read-preference routing to replicas with primary fallback,
+  READONLY→primary redirect;
+* satellites — RedisSink multi-generation restore walk, adaptive
+  ``retry_after_ms`` growth under load, InsertBatch rid-dedup,
+  inspect-quarantine CLI + quarantine size cap.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpubloom import checkpoint as ckpt
+from tpubloom import faults
+from tpubloom.config import FilterConfig
+from tpubloom.obs import counters as obs_counters
+from tpubloom.repl import OpLog, encode_record, scan_buffer
+from tpubloom.repl.log import DEFAULT_SEGMENT_BYTES
+from tpubloom.repl.record import decode_record
+from tpubloom.repl.replica import ReplicaApplier
+from tpubloom.server.client import BloomClient
+from tpubloom.server.protocol import BloomServiceError
+from tpubloom.server.service import BloomService, build_server
+
+from tests.fake_redis import FakeRedis
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _rand_keys(n, rng):
+    return [rng.bytes(16) for _ in range(n)]
+
+
+# -- record framing ----------------------------------------------------------
+
+
+def test_record_roundtrip():
+    rec = {
+        "seq": 7,
+        "method": "InsertBatch",
+        "rid": "abc",
+        "req": {"name": "f", "keys": [b"\x00k1", b"k2"]},
+        "ts": 123.5,
+    }
+    frame = encode_record(rec)
+    decoded, end = decode_record(frame)
+    assert decoded == rec and end == len(frame)
+
+
+def test_scan_buffer_detects_torn_tail():
+    frames = b"".join(
+        encode_record({"seq": i, "method": "Clear", "rid": None,
+                       "req": {"name": "f"}, "ts": 0.0})
+        for i in range(1, 4)
+    )
+    records, valid, clean = scan_buffer(frames)
+    assert [r["seq"] for r in records] == [1, 2, 3] and clean
+
+    # tear the last record: only the intact prefix survives
+    torn = frames[:-5]
+    records, valid, clean = scan_buffer(torn)
+    assert [r["seq"] for r in records] == [1, 2] and not clean
+    assert torn[:valid] == frames[: valid]
+
+    # flip a body byte: CRC catches it at that record
+    rotted = bytearray(frames)
+    rotted[-3] ^= 0xFF
+    records, _, clean = scan_buffer(bytes(rotted))
+    assert [r["seq"] for r in records] == [1, 2] and not clean
+
+
+# -- OpLog -------------------------------------------------------------------
+
+
+def test_oplog_append_read_and_recovery(tmp_path):
+    d = str(tmp_path / "log")
+    lg = OpLog(d)
+    for i in range(10):
+        lg.append("InsertBatch", {"name": "f", "keys": [b"k%d" % i]},
+                  rid="r%d" % i)
+    assert lg.last_seq == 10 and lg.first_seq == 1
+    recs = list(lg.read_from(4))
+    assert [r["seq"] for r in recs] == [5, 6, 7, 8, 9, 10]
+    assert recs[0]["req"]["keys"] == [b"k4"] and recs[0]["rid"] == "r4"
+    lg.close()
+
+    # clean reopen continues the sequence
+    lg2 = OpLog(d)
+    assert lg2.last_seq == 10
+    assert lg2.append("Clear", {"name": "f"}) == 11
+    lg2.close()
+
+
+def test_oplog_torn_tail_truncated_on_recovery(tmp_path):
+    d = str(tmp_path / "log")
+    lg = OpLog(d)
+    for i in range(5):
+        lg.append("Clear", {"name": "f"})
+    seg = os.path.join(d, os.listdir(d)[0])
+    lg.close()
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 7)  # crash mid-append
+    before = obs_counters.get("repl_log_torn_tail_truncated")
+    lg2 = OpLog(d)
+    assert lg2.last_seq == 4  # record 5 was torn off
+    assert obs_counters.get("repl_log_torn_tail_truncated") == before + 1
+    assert lg2.append("Clear", {"name": "f"}) == 5  # seq reuses the hole
+    lg2.close()
+
+
+def test_oplog_segments_roll_and_truncate(tmp_path):
+    d = str(tmp_path / "log")
+    lg = OpLog(d, segment_bytes=256)  # tiny: rolls every few records
+    for i in range(40):
+        lg.append("InsertBatch", {"name": "f", "keys": [b"key-%04d" % i]})
+    st = lg.stats()
+    assert st["segments"] > 2 and st["last_seq"] == 40
+    # records <= 20 covered by a (hypothetical) checkpoint: whole
+    # segments below the safe point drop, the tail stays readable
+    removed = lg.truncate_to(20)
+    assert removed >= 1
+    assert lg.first_seq > 1
+    remaining = [r["seq"] for r in lg.read_from(0)]
+    assert remaining == sorted(remaining) and remaining[-1] == 40
+    # nothing past the safe point is gone — the replay tail is complete
+    assert set(range(21, 41)).issubset(remaining)
+    recs = [r["seq"] for r in lg.read_from(25)]
+    assert recs == list(range(26, 41))
+    lg.close()
+
+
+def test_oplog_wait_for(tmp_path):
+    lg = OpLog(str(tmp_path / "log"))
+    assert not lg.wait_for(1, timeout=0.05)
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.05),
+                        lg.append("Clear", {"name": "f"})),
+    )
+    t.start()
+    assert lg.wait_for(1, timeout=5.0)
+    t.join()
+    lg.close()
+
+
+# -- server: op-log commit points + AOF-parity replay ------------------------
+
+
+def _server(tmp_path, subdir="ckpt", **kwargs):
+    sink_dir = str(tmp_path / subdir)
+    service = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), **kwargs
+    )
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    return srv, service, port
+
+
+def test_mutations_append_and_replay_restores_state(tmp_path):
+    oplog = OpLog(str(tmp_path / "log"))
+    srv, service, port = _server(tmp_path, oplog=oplog)
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    rng = np.random.default_rng(0)
+    keys = _rand_keys(300, rng)
+    client.create_filter("cnt", capacity=10_000, error_rate=0.01,
+                         counting=True)
+    client.insert_batch("cnt", keys)
+    client.delete_batch("cnt", keys[:100])
+    client.create_filter("gone", capacity=1000, error_rate=0.01)
+    client.drop_filter("gone")
+    assert oplog.last_seq == 5  # create, insert, delete, create, drop
+    client.close()
+    srv.stop(grace=None)
+    oplog.close()
+
+    # "crash": no checkpoint ever landed — the log alone rebuilds state
+    oplog2 = OpLog(str(tmp_path / "log"))
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(str(tmp_path / "ckpt")),
+        oplog=oplog2,
+    )
+    stats = service2.replay_oplog()
+    assert stats["applied"] == 5 and stats["failed"] == 0
+    srv2, port2 = build_server(service2, "127.0.0.1:0")
+    srv2.start()
+    c2 = BloomClient(f"127.0.0.1:{port2}")
+    c2.wait_ready()
+    assert c2.list_filters() == ["cnt"]
+    assert c2.include_batch("cnt", keys[100:]).all()
+    # counting counts survived exactly: one more delete empties them —
+    # a double-applied insert replay would leave them present
+    c2.delete_batch("cnt", keys[100:])
+    assert not c2.include_batch("cnt", keys[100:]).any()
+    c2.close()
+    srv2.stop(grace=None)
+    oplog2.close()
+
+
+def test_replay_is_gated_by_checkpoint_repl_seq(tmp_path):
+    """A checkpoint that landed AFTER some ops must make their replay a
+    no-op (the repl_seq stamp in the header gates them) — otherwise a
+    restart double-increments counting filters."""
+    oplog = OpLog(str(tmp_path / "log"))
+    srv, service, port = _server(tmp_path, oplog=oplog)
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    keys = [b"g%015d" % i for i in range(64)]
+    client.create_filter("cnt", capacity=10_000, error_rate=0.01,
+                         counting=True)
+    client.insert_batch("cnt", keys)          # seq 2 (counts -> 1)
+    client.checkpoint("cnt", wait=True)       # covers seq 2
+    client.insert_batch("cnt", [b"tail-key"])  # seq 3: after the ckpt
+    client.close()
+    srv.stop(grace=None)
+    oplog.close()
+
+    oplog2 = OpLog(str(tmp_path / "log"))
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(str(tmp_path / "ckpt")),
+        oplog=oplog2,
+    )
+    stats = service2.replay_oplog()
+    # create applies (restores the checkpoint), insert@2 skips, tail applies
+    assert stats["skipped"] >= 1, stats
+    mf = service2._filters["cnt"]
+    assert mf.applied_seq == 3
+    # counts stayed exactly 1: one delete -> gone
+    service2.DeleteBatch({"name": "cnt", "keys": keys})
+    hits = service2.QueryBatch({"name": "cnt", "keys": keys})
+    assert not np.unpackbits(
+        np.frombuffer(hits["hits"], np.uint8), count=hits["n"]
+    ).any()
+    hits = service2.QueryBatch({"name": "cnt", "keys": [b"tail-key"]})
+    assert np.unpackbits(
+        np.frombuffer(hits["hits"], np.uint8), count=1
+    ).all()
+    service2.shutdown()
+    oplog2.close()
+
+
+def test_checkpoint_keyed_log_truncation(tmp_path):
+    oplog = OpLog(str(tmp_path / "log"), segment_bytes=512)
+    srv, service, port = _server(tmp_path, oplog=oplog)
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    client.create_filter("t", capacity=10_000, error_rate=0.01)
+    for i in range(30):
+        client.insert_batch("t", [b"key-%06d" % i])
+    assert oplog.stats()["segments"] > 2
+    client.checkpoint("t", wait=True)  # covers every op so far
+    first_before = oplog.first_seq
+    service._maybe_truncate_log()
+    assert oplog.first_seq > first_before
+    # everything still needed for replay is intact
+    tail = [r["seq"] for r in oplog.read_from(0)]
+    assert tail == sorted(tail) and tail[-1] == oplog.last_seq
+    client.close()
+    srv.stop(grace=None)
+    oplog.close()
+
+
+def test_checkpoint_triggered_by_logged_batch_carries_its_seq(tmp_path):
+    """A checkpoint fired by notify_inserts for the very batch it
+    snapshots must stamp THAT batch's seq — otherwise a crash-replay
+    re-applies the batch over state that already contains it (review
+    finding on the _log_op/notify ordering)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    sink_dir = str(tmp_path / "ckpt")
+    service = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), oplog=oplog
+    )
+    service.CreateFilter(
+        {"name": "cnt", "capacity": 10_000, "error_rate": 0.01,
+         "options": {"counting": True, "checkpoint_every": 64}}
+    )
+    keys = [b"n%015d" % i for i in range(64)]
+    service.InsertBatch({"name": "cnt", "keys": keys})  # seq 2, triggers
+    mf = service._filters["cnt"]
+    assert mf.checkpointer.flush()
+    assert mf.checkpointer.last_landed_meta["repl_seq"] == 2
+    service.shutdown()
+    oplog.close()
+
+    # crash-replay: the insert must be gated by the checkpoint stamp
+    oplog2 = OpLog(str(tmp_path / "log"))
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), oplog=oplog2
+    )
+    stats = service2.replay_oplog()
+    assert stats["skipped"] >= 1, stats
+    service2.DeleteBatch({"name": "cnt", "keys": keys})
+    hits = service2.QueryBatch({"name": "cnt", "keys": keys})
+    assert not np.unpackbits(
+        np.frombuffer(hits["hits"], np.uint8), count=hits["n"]
+    ).any(), "insert replay double-incremented past its own checkpoint"
+    service2.shutdown()
+    oplog2.close()
+
+
+def test_log_id_rotates_on_rewind_and_forces_full_resync(tmp_path):
+    """Redis-replid parity: a cursor is only resumable against the same
+    log identity; recovery that lost records rotates it."""
+    d = str(tmp_path / "log")
+    lg = OpLog(d)
+    id1 = lg.log_id
+    for _ in range(4):
+        lg.append("Clear", {"name": "f"})
+    lg.close()
+    lg2 = OpLog(d)
+    assert lg2.log_id == id1  # clean reopen: same identity
+    seg = [f for f in os.listdir(d) if f.endswith(".seg")][0]
+    lg2.close()
+    path = os.path.join(d, seg)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)  # lose the tail record
+    lg3 = OpLog(d)
+    assert lg3.log_id != id1  # seq space rewound -> new identity
+
+    # a stale-id cursor gets a full resync even though the seq "exists"
+    from tpubloom.repl.primary import repl_stream
+
+    class _Ctx:
+        def is_active(self):
+            return False
+
+        def peer(self):
+            return "test"
+
+    service = BloomService(oplog=lg3)
+    gen = repl_stream(service, {"cursor": 1, "log_id": id1}, _Ctx())
+    assert next(gen)["kind"] == "full_sync_begin"
+    gen.close()
+    gen = repl_stream(service, {"cursor": 1, "log_id": lg3.log_id}, _Ctx())
+    assert next(gen)["kind"] == "partial_sync"
+    gen.close()
+    lg3.close()
+
+
+def test_full_resync_tail_does_not_replay_stale_drop(tmp_path):
+    """Review finding: with several filters, the full-resync tail starts
+    at the OLDEST snapshot seq — a Drop record older than a re-created
+    filter's snapshot must be gated, or the replica drops fresh state
+    (and loops full resyncs forever on the restored create)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    keys = [b"s%015d" % i for i in range(64)]
+    pc.create_filter("idle", capacity=1000, error_rate=0.01)   # seq 1
+    pc.create_filter("busy", capacity=10_000, error_rate=0.01)  # seq 2
+    pc.insert_batch("busy", keys)                               # seq 3
+    pc.checkpoint("busy", wait=True)
+    pc.drop_filter("busy")                                      # seq 4
+    pc.create_filter("busy", capacity=10_000, error_rate=0.01)  # seq 5 (restored)
+
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+    ).start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        time.sleep(0.3)  # a resync loop would show up as more full syncs
+        assert applier.full_syncs == 1, applier.status()
+        assert sorted(rc.list_filters()) == ["busy", "idle"]
+        assert rc.include_batch("busy", keys).all(), (
+            "stale Drop record deleted the re-created filter's state"
+        )
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+def test_truncation_sweep_from_create_drop_does_not_deadlock(
+    tmp_path, monkeypatch
+):
+    """Review finding: the truncation sweep re-takes service._lock, which
+    CreateFilter/DropFilter hold at their commit points — every append
+    must stay deadlock-free even when each one tries to sweep."""
+    from tpubloom.server import service as service_mod
+
+    monkeypatch.setattr(service_mod, "TRUNCATE_EVERY_APPENDS", 1)
+    oplog = OpLog(str(tmp_path / "log"), segment_bytes=128)
+    service = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(str(tmp_path / "ckpt")),
+        oplog=oplog,
+    )
+    done = threading.Event()
+
+    def drive():
+        for i in range(4):
+            service.CreateFilter(
+                {"name": f"f{i}", "capacity": 1000, "error_rate": 0.01}
+            )
+            service.InsertBatch({"name": f"f{i}", "keys": [b"k%d" % i]})
+        for i in range(4):
+            service.DropFilter({"name": f"f{i}"})
+        done.set()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert done.is_set(), "create/drop deadlocked against the log sweep"
+    service.shutdown()
+    oplog.close()
+
+
+def test_manifest_restores_filter_whose_create_was_truncated(tmp_path):
+    """Review finding: truncation can drop a filter's CreateFilter record
+    while its post-checkpoint records remain — replay must still bring
+    the filter back (creation manifest) or acked writes are lost."""
+    oplog = OpLog(str(tmp_path / "log"), segment_bytes=256)
+    srv, service, port = _server(tmp_path, oplog=oplog)
+    client = BloomClient(f"127.0.0.1:{port}")
+    client.wait_ready()
+    client.create_filter("m", capacity=10_000, error_rate=0.01)  # seq 1
+    base = [b"base-%06d" % i for i in range(20)]
+    for k in base:
+        client.insert_batch("m", [k])
+    client.checkpoint("m", wait=True)  # covers everything so far
+    service._maybe_truncate_log()
+    assert oplog.first_seq > 1, "create record should be truncated away"
+    client.insert_batch("m", [b"tail-after-ckpt"])  # NOT checkpointed
+    client.close()
+    srv.stop(grace=None)
+    oplog.close()
+
+    oplog2 = OpLog(str(tmp_path / "log"), segment_bytes=256)
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(str(tmp_path / "ckpt")),
+        oplog=oplog2,
+    )
+    stats = service2.replay_oplog()
+    assert stats["restored_from_manifest"] == 1, stats
+    assert "m" in service2._filters, "manifest did not re-create the filter"
+    hits = service2.QueryBatch({"name": "m", "keys": base + [b"tail-after-ckpt"]})
+    got = np.unpackbits(np.frombuffer(hits["hits"], np.uint8), count=hits["n"])
+    assert got.all(), "acked writes lost across truncation + restart"
+    service2.shutdown()
+    oplog2.close()
+
+
+def test_replica_fresh_create_does_not_resurrect_local_checkpoint(tmp_path):
+    """Review finding: a replica applying a FRESH CreateFilter record
+    must not restore its own stale local checkpoint of a previous
+    same-name filter (restore-on-create defaults True)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    psvc = BloomService(oplog=oplog)  # primary WITHOUT sinks: creates stay fresh
+    psrv, pport = build_server(psvc, "127.0.0.1:0")
+    psrv.start()
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    keys = [b"old-%012d" % i for i in range(32)]
+    pc.create_filter("a", capacity=10_000, error_rate=0.01,
+                     checkpoint_every=16)
+    pc.insert_batch("a", keys)
+
+    rsink = str(tmp_path / "replica-ckpt")
+    rsvc = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(rsink), read_only=True
+    )
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+    ).start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert rc.include_batch("a", keys).all()
+        # the replica checkpointed the old contents into ITS OWN sink
+        pc.drop_filter("a")   # replica drop -> final local checkpoint too
+        pc.create_filter("a", capacity=10_000, error_rate=0.01,
+                         checkpoint_every=16)  # fresh on the primary
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert ckpt.FileSink(rsink).list_seqs("a"), (
+            "test setup: replica never checkpointed locally"
+        )
+        assert not rc.include_batch("a", keys).any(), (
+            "replica resurrected dropped keys from its local checkpoint"
+        )
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+def test_append_failure_failstops_writes_and_degrades_health(tmp_path):
+    """Review finding: an op applied in memory whose log append fails
+    leaves the primary ahead of its own log — further writes must be
+    fail-stopped (Redis MISCONF parity) and Health must say why; reads
+    keep serving."""
+    oplog = OpLog(str(tmp_path / "log"))
+    srv, service, port = _server(tmp_path, oplog=oplog)
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=0)
+    client.wait_ready()
+    try:
+        client.create_filter("fs", capacity=1000, error_rate=0.01)
+        client.insert_batch("fs", [b"before"])
+        faults.arm("repl.append", "once")
+        with pytest.raises(BloomServiceError, match="INTERNAL"):
+            client.insert_batch("fs", [b"lost"])
+        # writes are now fail-stopped with a structured error...
+        with pytest.raises(BloomServiceError, match="LOG_WRITE_FAILED"):
+            client.insert_batch("fs", [b"after"])
+        h = client.health()
+        assert h["status"] == "DEGRADED"
+        assert "oplog_append_error" in h["reasons"]
+        # ...but reads keep serving
+        assert client.include("fs", b"before")
+    finally:
+        client.close()
+        srv.stop(grace=None)
+        oplog.close()
+
+
+def test_replayed_insert_checkpoint_carries_record_seq(tmp_path):
+    """Review finding (replay-path mirror of the notify ordering fix): a
+    checkpoint triggered DURING replay by the replayed batch itself must
+    stamp that record's seq."""
+    oplog = OpLog(str(tmp_path / "log"))
+    sink_dir = str(tmp_path / "ckpt")
+    service = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), oplog=oplog
+    )
+    service.CreateFilter(
+        {"name": "r", "capacity": 10_000, "error_rate": 0.01,
+         "options": {"counting": True, "checkpoint_every": 64}}
+    )
+    keys = [b"rp%014d" % i for i in range(64)]
+    service.InsertBatch({"name": "r", "keys": keys})  # seq 2
+    # crash WITHOUT the checkpoint landing: nuke the sink
+    service._filters["r"].checkpointer.close(final_checkpoint=False)
+    for fn in os.listdir(sink_dir):
+        os.unlink(os.path.join(sink_dir, fn))
+    oplog.close()
+
+    oplog2 = OpLog(str(tmp_path / "log"))
+    service2 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), oplog=oplog2
+    )
+    service2.replay_oplog()  # the replayed insert re-triggers a checkpoint
+    mf = service2._filters["r"]
+    assert mf.checkpointer.flush()
+    assert mf.checkpointer.last_landed_meta["repl_seq"] == 2
+
+    # third generation: crash again and replay over THAT checkpoint —
+    # counts must stay exactly 1
+    service2.shutdown()
+    oplog2.close()
+    oplog3 = OpLog(str(tmp_path / "log"))
+    service3 = BloomService(
+        sink_factory=lambda config: ckpt.FileSink(sink_dir), oplog=oplog3
+    )
+    stats = service3.replay_oplog()
+    assert stats["failed"] == 0
+    service3.DeleteBatch({"name": "r", "keys": keys})
+    hits = service3.QueryBatch({"name": "r", "keys": keys})
+    assert not np.unpackbits(
+        np.frombuffer(hits["hits"], np.uint8), count=hits["n"]
+    ).any(), "replay-triggered checkpoint stamped a stale repl_seq"
+    service3.shutdown()
+    oplog3.close()
+
+
+def test_full_resync_tail_includes_creates_after_plan(tmp_path):
+    """Review finding (reproduced): the resync tail cursor must be
+    clamped to the log head at plan time — a CreateFilter committed
+    between the plan freeze and the snapshot stamps is not in the
+    announced filter list, so skipping its record would silently lose
+    the filter on the replica forever."""
+    from tpubloom.repl.primary import repl_stream
+
+    class _LiveCtx:
+        def is_active(self):
+            return True
+
+        def peer(self):
+            return "test"
+
+    oplog = OpLog(str(tmp_path / "log"))
+    service = BloomService(oplog=oplog)
+    service.CreateFilter({"name": "f1", "capacity": 1000,
+                          "error_rate": 0.01})                    # seq 1
+    service.InsertBatch({"name": "f1", "keys": [b"a"]})           # seq 2
+    gen = repl_stream(service, {}, _LiveCtx(), heartbeat_s=0.05)
+    begin = next(gen)  # plan frozen here, before these commits:
+    assert begin["kind"] == "full_sync_begin" and begin["filters"] == ["f1"]
+    service.CreateFilter({"name": "f2", "capacity": 1000,
+                          "error_rate": 0.01})                    # seq 3
+    service.InsertBatch({"name": "f1", "keys": [b"b"]})           # seq 4
+    msg = next(gen)
+    while msg["kind"] != "full_sync_end":
+        msg = next(gen)
+    assert msg["cursor"] <= 2, (
+        f"tail cursor {msg['cursor']} skips the concurrent create (seq 3)"
+    )
+    recs = []
+    while len(recs) < 2:
+        msg = next(gen)
+        if msg["kind"] == "record":
+            recs.append(msg)
+    assert [r["seq"] for r in recs] == [3, 4]
+    assert recs[0]["method"] == "CreateFilter"
+    assert recs[0]["req"]["name"] == "f2"
+    gen.close()
+    oplog.close()
+
+
+# -- replica end-to-end (the acceptance scenario) ----------------------------
+
+
+def test_replica_end_to_end_with_mid_stream_kill(tmp_path):
+    """Acceptance: primary + K keys -> replica syncs to lag 0 and answers
+    QueryBatch identically; killing the stream mid-batch and reconnecting
+    double-applies nothing (counting counts unchanged on replay)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    rng = np.random.default_rng(3)
+    keys = _rand_keys(500, rng)
+    pc.create_filter("cnt", capacity=20_000, error_rate=0.01, counting=True)
+    pc.insert_batch("cnt", keys)  # every count exactly 1
+
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+    ).start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_caught_up(30), applier.status()
+        assert obs_counters.get_gauge("repl_lag_seq") == 0
+        assert applier.full_syncs == 1
+
+        # identical membership, replica-side
+        assert rc.include_batch("cnt", keys).all()
+        absent = _rand_keys(500, rng)
+        np.testing.assert_array_equal(
+            rc.include_batch("cnt", absent), pc.include_batch("cnt", absent)
+        )
+        assert rc.health()["role"] == "replica"
+
+        # kill the stream mid-batch; the reconnect must not double-apply
+        faults.arm("repl.stream_send", "once")
+        pc.insert_batch("cnt", _rand_keys(100, rng))
+        deadline = time.monotonic() + 30
+        while applier.partial_syncs == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert applier.partial_syncs >= 1, applier.status()
+        assert applier.wait_caught_up(30), applier.status()
+
+        # exactly-once proof: counts are still 1, so ONE delete empties
+        pc.delete_batch("cnt", keys)
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert not rc.include_batch("cnt", keys).any(), (
+            "replayed records double-applied on the replica"
+        )
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+def test_replica_full_resync_on_restored_create(tmp_path):
+    """A CreateFilter that bootstrapped from a checkpoint the replica
+    does not have forces a full resync (the record alone cannot carry
+    those bytes)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    keys = [b"r%015d" % i for i in range(128)]
+    pc.create_filter("warm", capacity=10_000, error_rate=0.01)
+    pc.insert_batch("warm", keys)
+
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+    ).start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_caught_up(30)
+        pc.drop_filter("warm")  # final checkpoint lands in the sink
+        # recreate: restores from checkpoint -> record is resync-marked
+        pc.create_filter("warm", capacity=10_000, error_rate=0.01)
+        deadline = time.monotonic() + 30
+        while applier.full_syncs < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert applier.full_syncs >= 2, applier.status()
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert rc.include_batch("warm", keys).all()
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+def test_readonly_rejection_and_redirect(tmp_path):
+    # bare replica (no known primary): structured READONLY surfaces
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        rc.wait_ready()
+        with pytest.raises(BloomServiceError, match="READONLY"):
+            rc.insert_batch("any", [b"x"])
+        with pytest.raises(BloomServiceError, match="READONLY"):
+            rc.create_filter("any", capacity=100, error_rate=0.1)
+    finally:
+        rc.close()
+        rsrv.stop(grace=None)
+
+    # replica that knows its primary: the client follows the redirect
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    rsvc2 = BloomService(read_only=True)
+    rsrv2, rport2 = build_server(rsvc2, "127.0.0.1:0")
+    rsrv2.start()
+    applier = ReplicaApplier(rsvc2, f"127.0.0.1:{pport}").start()
+    # the client was (mis)pointed at the replica — writes still land
+    c = BloomClient(f"127.0.0.1:{rport2}")
+    try:
+        c.wait_ready()
+        c.create_filter("redir", capacity=1000, error_rate=0.01)
+        c.insert_batch("redir", [b"via-redirect"])
+        assert c.address == f"127.0.0.1:{pport}"  # followed the redirect
+        assert obs_counters.get("client_primary_redirects") >= 1
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        # ...and the replica serves the write back
+        rdirect = BloomClient(f"127.0.0.1:{rport2}")
+        assert rdirect.include("redir", b"via-redirect")
+        rdirect.close()
+    finally:
+        applier.stop()
+        c.close()
+        psrv.stop(grace=None)
+        rsrv2.stop(grace=None)
+        oplog.close()
+
+
+def test_client_read_preference_routes_to_replica(tmp_path):
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(rsvc, f"127.0.0.1:{pport}").start()
+    client = BloomClient(
+        f"127.0.0.1:{pport}",
+        replicas=[f"127.0.0.1:{rport}"],
+        read_preference="replica",
+    )
+    try:
+        client.wait_ready()
+        keys = [b"rp%014d" % i for i in range(64)]
+        client.create_filter("route", capacity=10_000, error_rate=0.01)
+        client.insert_batch("route", keys)
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert client.include_batch("route", keys).all()
+        # the replica served the read, the primary served the write
+        assert rsvc.metrics.snapshot()["counters"]["keys_queried"] >= 64
+        assert psvc.metrics.snapshot()["counters"]["keys_inserted"] == 64
+        # replica down -> reads fall back to the primary, not to errors
+        applier.stop()
+        rsrv.stop(grace=None)
+        assert client.include_batch("route", keys).all()
+        assert obs_counters.get("client_replica_fallbacks") >= 1
+    finally:
+        client.close()
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+# -- MONITOR parity ----------------------------------------------------------
+
+
+def test_monitor_stream_filters_by_name(tmp_path):
+    srv, service, port = _server(tmp_path)
+    client = BloomClient(f"127.0.0.1:{port}")
+    other = BloomClient(f"127.0.0.1:{port}")
+    try:
+        client.wait_ready()
+        client.create_filter("a", capacity=1000, error_rate=0.01)
+        client.create_filter("b", capacity=1000, error_rate=0.01)
+        mon = other.monitor("a")
+        it = iter(mon)
+        assert next(it)["kind"] == "hello"
+        client.insert_batch("b", [b"not-mine"])
+        client.insert_batch("a", [b"mine"])
+        client.include_batch("a", [b"mine"])
+        seen = []
+        for msg in it:
+            if msg["kind"] == "op":
+                seen.append(msg)
+                if len(seen) == 2:
+                    break
+        mon.cancel()
+        assert [m["method"] for m in seen] == ["InsertBatch", "QueryBatch"]
+        assert all(m["name"] == "a" for m in seen)
+        assert seen[0]["rid"] and seen[0]["batch"] == 1
+    finally:
+        other.close()
+        client.close()
+        srv.stop(grace=None)
+
+
+# -- satellites --------------------------------------------------------------
+
+
+@pytest.fixture()
+def fake_redis():
+    r = FakeRedis()
+    yield r
+    r.close()
+
+
+def test_redis_sink_multi_generation_walk(fake_redis):
+    """RedisSink keeps N generations + list_seqs: the corrupt-newest
+    restore walk works there like on a FileSink (PR-2 follow-up)."""
+    from tpubloom.filter import BloomFilter
+
+    cfg = FilterConfig(m=1 << 16, k=4, key_name="rsink")
+    sink = ckpt.RedisSink("127.0.0.1", fake_redis.port)
+    f = BloomFilter(cfg)
+    keys_a = [b"a%015d" % i for i in range(100)]
+    f.insert_batch(keys_a)
+    seq_a = ckpt.save(f, sink)
+    f.insert_batch([b"b%015d" % i for i in range(50)])
+    seq_b = ckpt.save(f, sink, seq=seq_a + 1)
+    assert sink.list_seqs("rsink") == [seq_b, seq_a]
+
+    # corrupt the newest generation in place
+    gen_key = f"rsink:tpubloom.ckpt:{seq_b:012d}".encode()
+    blob = bytearray(fake_redis.data[gen_key])
+    blob[-4] ^= 0xFF
+    fake_redis.data[gen_key] = blob
+
+    before = obs_counters.get("ckpt_corrupt_detected")
+    restored = ckpt.restore(cfg, sink)
+    assert restored is not None
+    assert restored._restored_seq == seq_a  # fell back a generation
+    assert np.asarray(restored.include_batch(keys_a)).all()
+    assert obs_counters.get("ckpt_corrupt_detected") == before + 1
+    # the corpse was quarantined out of the index, preserved for autopsy
+    assert sink.list_seqs("rsink") == [seq_a]
+    assert f"rsink:tpubloom.ckpt.corrupt:{seq_b:012d}".encode() in fake_redis.data
+
+    # retention GC parity
+    for i in range(6):
+        ckpt.save(f, sink, seq=seq_b + 1 + i)
+    assert sink.prune("rsink", keep=2) > 0
+    assert len(sink.list_seqs("rsink")) == 2
+    sink.close()
+
+
+def test_redis_sink_legacy_single_blob_still_restores(fake_redis):
+    """Sinks written before the index existed restore through the legacy
+    key fallback."""
+    from tpubloom.filter import BloomFilter
+
+    cfg = FilterConfig(m=1 << 16, k=4, key_name="legacy")
+    sink = ckpt.RedisSink("127.0.0.1", fake_redis.port)
+    f = BloomFilter(cfg)
+    f.insert_batch([b"x%015d" % i for i in range(64)])
+    seq = ckpt.save(f, sink)
+    # simulate the pre-ISSUE-3 layout: only bitmap + legacy blob keys
+    fake_redis.data.pop(b"legacy:tpubloom.ckpt.seqs")
+    fake_redis.data.pop(f"legacy:tpubloom.ckpt:{seq:012d}".encode())
+    assert sink.list_seqs("legacy") == [seq]
+    restored = ckpt.restore(cfg, sink)
+    assert restored is not None and restored._restored_seq == seq
+    sink.close()
+
+
+def test_adaptive_retry_after_grows_under_load():
+    """The ISSUE-3 satellite contract: the hint starts at the base and
+    grows while sheds keep arriving (pressure), then decays back."""
+    service = BloomService(max_in_flight=1, retry_after_ms=20)
+    assert service.admit("QueryBatch") is None  # occupy the only slot
+    hints = []
+    for _ in range(8):
+        shed = service.admit("QueryBatch")
+        assert shed is not None
+        hints.append(shed["error"]["details"]["retry_after_ms"])
+    assert hints[0] == 20  # first shed of a burst: the configured base
+    assert hints[-1] > hints[0]  # grows under sustained load
+    assert hints == sorted(hints)  # monotone while hammering
+    assert hints[-1] <= 20 * 32  # capped
+    # decay: after a quiet second the hint returns toward the base
+    time.sleep(1.2)
+    shed = service.admit("QueryBatch")
+    assert shed["error"]["details"]["retry_after_ms"] < hints[-1]
+    service.release("QueryBatch")
+
+
+def test_counting_insert_dedup_replay_answers_from_cache():
+    """rid-replayed counting InsertBatch must not double-increment
+    (shared machinery with DeleteBatch; also what makes it retryable on
+    UNAVAILABLE)."""
+    service = BloomService()
+    service.CreateFilter(
+        {"name": "cnt", "capacity": 10_000, "error_rate": 0.01,
+         "options": {"counting": True}}
+    )
+    keys = [b"i%015d" % i for i in range(16)]
+    req = {"name": "cnt", "keys": keys, "rid": "rid-ins-1"}
+    r1 = service.InsertBatch(req)
+    r2 = service.InsertBatch(req)  # replay of the same logical call
+    assert r1 == r2
+    assert service.metrics.snapshot()["counters"]["insert_dedup_hits"] == 1
+    # counts stayed at 1: one delete -> absent (a double increment would
+    # leave them present)
+    service.DeleteBatch({"name": "cnt", "keys": keys, "rid": "rid-del-1"})
+    hits = service.QueryBatch({"name": "cnt", "keys": keys})
+    assert not np.unpackbits(
+        np.frombuffer(hits["hits"], np.uint8), count=hits["n"]
+    ).any()
+
+
+def test_presence_insert_dedup_replays_cached_bits(tmp_path):
+    service = BloomService()
+    service.CreateFilter(
+        {"name": "p", "config": {"m": 1 << 18, "k": 4, "block_bits": 512}}
+    )
+    keys = [b"p%015d" % i for i in range(32)]
+    req = {"name": "p", "keys": keys, "return_presence": True,
+           "rid": "rid-pres-1"}
+    r1 = service.InsertBatch(req)
+    assert not np.unpackbits(
+        np.frombuffer(r1["presence"], np.uint8), count=32
+    ).any()
+    r2 = service.InsertBatch(req)  # replay: cached bits, NOT all-present
+    assert r1 == r2
+
+
+def test_plain_insert_not_cached():
+    """Idempotent inserts skip the cache — replaying them is harmless
+    and cache slots are better spent on the non-idempotent ops."""
+    service = BloomService(dedup_capacity=8)
+    service.CreateFilter({"name": "f", "capacity": 1000, "error_rate": 0.01})
+    req = {"name": "f", "keys": [b"x"], "rid": "rid-plain"}
+    service.InsertBatch(req)
+    assert "rid-plain" not in service._dedup
+
+
+def test_inspect_quarantine_cli(tmp_path, capsys):
+    from tpubloom.filter import BloomFilter
+    from tpubloom.server.service import main as server_main
+
+    d = str(tmp_path / "ckpt")
+    sink = ckpt.FileSink(d)
+    cfg = FilterConfig(m=1 << 16, k=4, key_name="q")
+    f = BloomFilter(cfg)
+    f.insert_batch([b"k%015d" % i for i in range(32)])
+    seq_a = ckpt.save(f, sink)
+    seq_b = ckpt.save(f, sink, seq=seq_a + 1)
+    path = sink._path("q", seq_b)
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    assert ckpt.restore(cfg, sink) is not None  # quarantines seq_b
+
+    report = ckpt.inspect_quarantine(d)
+    assert len(report["entries"]) == 1
+    entry = report["entries"][0]
+    assert "CRC32C mismatch" in entry["diagnosis"]
+    assert entry["header"]["seq"] == seq_b  # header survived for autopsy
+
+    # the CLI path: list, then purge
+    with pytest.raises(SystemExit) as e:
+        server_main(["inspect-quarantine", d])
+    assert e.value.code == 0
+    assert "CRC32C mismatch" in capsys.readouterr().out
+    with pytest.raises(SystemExit) as e:
+        server_main(["inspect-quarantine", d, "--purge", "--json"])
+    assert e.value.code == 0
+    assert '"purged": 1' in capsys.readouterr().out
+    assert ckpt.inspect_quarantine(d)["entries"] == []
+
+
+def test_quarantine_size_cap_evicts_oldest(tmp_path):
+    from tpubloom.filter import BloomFilter
+
+    d = str(tmp_path / "ckpt")
+    cfg = FilterConfig(m=1 << 16, k=4, key_name="cap")
+    f = BloomFilter(cfg)
+    f.insert_batch([b"c%015d" % i for i in range(16)])
+    _, _, blob = ckpt.snapshot_blob(f)
+    torn = blob[: len(blob) // 2]
+    # cap fits two torn blobs but not three: the third quarantine must
+    # evict the oldest corpse
+    sink = ckpt.FileSink(d, quarantine_max_bytes=2 * len(torn) + 16)
+    for i, seq in enumerate([100, 200, 300]):
+        sink.put("cap", seq, torn)
+        os.utime(sink._path("cap", seq), (1000 + i, 1000 + i))
+        assert sink.quarantine("cap", seq) is not None
+    qdir = os.path.join(d, ckpt.FileSink.CORRUPT_SUBDIR)
+    left = sorted(os.listdir(qdir))
+    assert len(left) == 2  # oldest evicted
+    assert f"cap.{100:012d}.ckpt" not in left
+    assert obs_counters.get("ckpt_quarantine_evicted") >= 1
+
+
+def test_repl_smoke():
+    """benchmarks/repl_smoke.py end-to-end check runs in tier-1 so the
+    replication surface cannot silently rot."""
+    import importlib
+    import sys
+
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+    sys.path.insert(0, os.path.abspath(bench_dir))
+    try:
+        repl_smoke = importlib.import_module("repl_smoke")
+        result = repl_smoke.run_smoke()
+    finally:
+        sys.path.pop(0)
+    assert result["replica_caught_up"]
+    assert result["double_applied"] == 0
+    assert result["monitor_events"] >= 1

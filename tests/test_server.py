@@ -227,9 +227,6 @@ def test_scalable_bare_attach_and_policy_drift(server):
     assert resp["existed"] and resp["scalable"]["capacity"] == 300
     with pytest.raises(BloomServiceError, match="CONFIG_MISMATCH"):
         client.create_filter("sc-b", scalable=True, growth=4, exist_ok=True)
-    # scalable insert replay safety: inserts on scalable filters are never
-    # auto-retried (layer fill counts are not idempotent)
-    assert client._maybe_nonidempotent_insert("sc-b")
 
 
 def test_sharded_counting_filter_via_server(server, tmp_path):

@@ -233,8 +233,19 @@ class FileSink:
 
     CORRUPT_SUBDIR = "corrupt"
 
-    def __init__(self, directory: str):
+    #: Default cap on the quarantine dir: corrupt blobs are post-mortem
+    #: material, not an unbounded landfill — oldest corpses are dropped
+    #: once the dir exceeds this (ISSUE 3 satellite; operators inspect
+    #: survivors with ``python -m tpubloom.server inspect-quarantine``).
+    QUARANTINE_MAX_BYTES = 256 << 20
+
+    def __init__(self, directory: str, *, quarantine_max_bytes: Optional[int] = None):
         self.directory = directory
+        self.quarantine_max_bytes = (
+            self.QUARANTINE_MAX_BYTES
+            if quarantine_max_bytes is None
+            else quarantine_max_bytes
+        )
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, key_name: str, seq: int) -> str:
@@ -303,7 +314,35 @@ class FileSink:
             os.replace(src, dst)
         except FileNotFoundError:
             return None
+        self._enforce_quarantine_cap(qdir, protect=dst)
         return dst
+
+    def _enforce_quarantine_cap(self, qdir: str, protect: str) -> None:
+        """Drop oldest quarantined blobs until the dir fits the cap (the
+        just-quarantined file is protected — the freshest corpse is the
+        one an operator most wants to autopsy). 0 disables the cap."""
+        if not self.quarantine_max_bytes:
+            return
+        entries = []
+        for fn in os.listdir(qdir):
+            path = os.path.join(qdir, fn)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, path))
+        total = sum(size for _, size, _ in entries)
+        for _, size, path in sorted(entries):
+            if total <= self.quarantine_max_bytes:
+                break
+            if path == protect:
+                continue
+            try:
+                os.unlink(path)
+                total -= size
+                _counters.incr("ckpt_quarantine_evicted")
+            except OSError:
+                pass
 
     def prune(self, key_name: str, keep: int = 2) -> int:
         """Drop all but the newest ``keep`` generations (quarantined files
@@ -323,10 +362,26 @@ class FileSink:
 class RedisSink:
     """Checkpoints into a live Redis, keeping the reference's storage model.
 
-    Two keys are written: ``<key_name>`` holds the RAW Redis bitmap — the
-    exact string the reference's ``:ruby`` driver GETBITs against, readable
-    by stock Redis tooling — and ``<key_name>:tpubloom.ckpt`` holds the
-    framed checkpoint (header + payload) for seq/config-aware restore.
+    Multi-generation parity with :class:`FileSink` (ISSUE 3 satellite —
+    closes the PR-2 "single newest blob = data loss" follow-up). Keys
+    written per checkpoint:
+
+    * ``<key_name>`` — the RAW Redis bitmap (flat layouts), the exact
+      string the reference's ``:ruby`` driver GETBITs against;
+    * ``<key_name>:tpubloom.ckpt:<seq>`` — the framed blob for that
+      generation (header + payload, seq/config-aware restore);
+    * ``<key_name>:tpubloom.ckpt.seqs`` — JSON index of retained seqs,
+      newest first (the RESP client has no KEYS/SCAN, so enumeration is
+      explicit — and atomic per sink because every mutation runs under
+      the sink lock);
+    * ``<key_name>:tpubloom.ckpt`` — the newest blob under the legacy
+      key, kept so pre-ISSUE-3 readers still restore.
+
+    With ``list_seqs``/``quarantine``/``prune`` present, the corrupt-
+    newest restore walk and the retention GC behave exactly as on a
+    :class:`FileSink`: a bit-rotted newest generation is copied to
+    ``<key_name>:tpubloom.ckpt.corrupt:<seq>``, dropped from the index,
+    and the previous generation restores.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379, **kwargs):
@@ -335,34 +390,174 @@ class RedisSink:
         self._client = RespClient(host, port, **kwargs)
         self._lock = threading.Lock()
 
+    def _index_key(self, key_name: str) -> str:
+        return f"{key_name}:tpubloom.ckpt.seqs"
+
+    def _gen_key(self, key_name: str, seq: int) -> str:
+        return f"{key_name}:tpubloom.ckpt:{seq:012d}"
+
+    def _read_index(self, key_name: str) -> list:
+        """Retained seqs newest-first (caller holds the lock). Falls back
+        to the legacy single-blob key for sinks written before the
+        index existed."""
+        raw = self._client.get(self._index_key(key_name))
+        if raw is not None:
+            return sorted((int(s) for s in json.loads(raw)), reverse=True)
+        legacy = self._client.get(f"{key_name}:tpubloom.ckpt")
+        if legacy is None:
+            return []
+        try:
+            header, _ = _deserialize(legacy)
+        except ValueError:
+            return []
+        return [int(header["seq"])]
+
+    def _write_index(self, key_name: str, seqs: list) -> None:
+        self._client.set(
+            self._index_key(key_name),
+            json.dumps(sorted(set(seqs), reverse=True)).encode(),
+        )
+
     def put(self, key_name: str, seq: int, blob: bytes) -> None:
         header, payload = _deserialize(blob)
         with self._lock:
             if header["format"] == "redis_bitmap":
                 self._client.set(key_name, payload)
-            self._client.set(f"{key_name}:tpubloom.ckpt", blob)
+            self._client.set(self._gen_key(key_name, seq), blob)
+            self._client.set(f"{key_name}:tpubloom.ckpt", blob)  # legacy readers
+            self._write_index(key_name, self._read_index(key_name) + [seq])
+
+    def list_seqs(self, key_name: str) -> list:
+        """All retained generations, newest first (FileSink parity)."""
+        with self._lock:
+            return self._read_index(key_name)
 
     def latest_seq(self, key_name: str) -> Optional[int]:
-        blob = self.get(key_name)
-        if blob is None:
-            return None
-        header, _ = _deserialize(blob)
-        return header["seq"]
+        seqs = self.list_seqs(key_name)
+        return seqs[0] if seqs else None
 
     def get(self, key_name: str, seq: Optional[int] = None) -> Optional[bytes]:
         with self._lock:
-            blob = self._client.get(f"{key_name}:tpubloom.ckpt")
-        if blob is not None and seq is not None:
-            header, _ = _deserialize(blob)
-            if header["seq"] != seq:
-                raise ValueError(
-                    f"RedisSink keeps only the newest checkpoint "
-                    f"(seq {header['seq']}); requested seq {seq} is unavailable"
-                )
-        return blob
+            if seq is None:
+                seqs = self._read_index(key_name)
+                if not seqs:
+                    return None
+                seq = seqs[0]
+            blob = self._client.get(self._gen_key(key_name, seq))
+            if blob is None:
+                # legacy layout: the only copy lives under the bare key
+                blob = self._client.get(f"{key_name}:tpubloom.ckpt")
+                if blob is not None:
+                    try:
+                        header, _ = _deserialize(blob)
+                    except ValueError:
+                        return None  # corrupt legacy blob: nothing older exists
+                    if header["seq"] != seq:
+                        return None
+            return blob
+
+    def quarantine(self, key_name: str, seq: int) -> Optional[str]:
+        """Move a corrupt generation to ``...ckpt.corrupt:<seq>`` and drop
+        it from the index so the restore walk never re-reads it; returns
+        the corrupt key (None if the blob vanished underneath us)."""
+        with self._lock:
+            gen = self._gen_key(key_name, seq)
+            blob = self._client.get(gen)
+            if blob is None:
+                blob = self._client.get(f"{key_name}:tpubloom.ckpt")
+            dst = f"{key_name}:tpubloom.ckpt.corrupt:{seq:012d}"
+            if blob is not None:
+                self._client.set(dst, blob)
+            self._client.delete(gen)
+            self._write_index(
+                key_name,
+                [s for s in self._read_index(key_name) if s != seq],
+            )
+            return dst if blob is not None else None
+
+    def prune(self, key_name: str, keep: int = 2) -> int:
+        """Drop all but the newest ``keep`` generations (retention GC,
+        FileSink parity); returns generations removed."""
+        with self._lock:
+            seqs = self._read_index(key_name)
+            victims = seqs[keep:] if keep else seqs
+            for s in victims:
+                self._client.delete(self._gen_key(key_name, s))
+            if victims:
+                self._write_index(key_name, seqs[:keep] if keep else [])
+            return len(victims)
 
     def close(self) -> None:
         self._client.close()
+
+
+def inspect_quarantine(directory: str, *, purge: bool = False) -> dict:
+    """Operator view of ``<directory>/corrupt/`` (ISSUE 3 satellite;
+    CLI: ``python -m tpubloom.server inspect-quarantine``).
+
+    Each entry carries a ``diagnosis`` from re-running the integrity
+    checks: what exactly is broken (header CRC, payload CRC, truncation
+    ...) plus the header fields when they are still readable — enough to
+    decide whether the corpse is worth a deeper post-mortem before
+    ``--purge`` drops it."""
+    qdir = os.path.join(directory, FileSink.CORRUPT_SUBDIR)
+    entries = []
+    if os.path.isdir(qdir):
+        for fn in sorted(os.listdir(qdir)):
+            path = os.path.join(qdir, fn)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            diagnosis, header_info = "unreadable", None
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                try:
+                    _deserialize(blob)
+                    diagnosis = "intact (quarantined by an older build?)"
+                except CheckpointCorruptError as e:
+                    diagnosis = str(e)
+                # best effort: a payload-corrupt blob still has a good
+                # header — surface seq/config for the post-mortem
+                if blob.startswith(MAGIC_V2):
+                    off = len(MAGIC_V2)
+                    hlen = int.from_bytes(blob[off : off + 8], "little")
+                    hdr = blob[off + 12 : off + 12 + hlen]
+                    if len(hdr) == hlen and crc32c(hdr) == int.from_bytes(
+                        blob[off + 8 : off + 12], "little"
+                    ):
+                        h = json.loads(hdr)
+                        header_info = {
+                            "seq": h.get("seq"),
+                            "format": h.get("format"),
+                            "time": h.get("time"),
+                        }
+            except OSError as e:
+                diagnosis = f"read failed: {e}"
+            entries.append(
+                {
+                    "file": fn,
+                    "bytes": st.st_size,
+                    "mtime": st.st_mtime,
+                    "diagnosis": diagnosis,
+                    "header": header_info,
+                }
+            )
+    purged = 0
+    if purge:
+        for e in entries:
+            try:
+                os.unlink(os.path.join(qdir, e["file"]))
+                purged += 1
+            except OSError:
+                pass
+    return {
+        "quarantine_dir": qdir,
+        "entries": entries,
+        "total_bytes": sum(e["bytes"] for e in entries),
+        "purged": purged,
+    }
 
 
 def _device_snapshot(words):
@@ -389,8 +584,17 @@ def _usage_extra(filter_obj) -> dict:
     }
 
 
-def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] = None) -> int:
-    """Synchronous snapshot of any filter (plain/counting/sharded/scalable)."""
+def snapshot_blob(
+    filter_obj, *, seq: Optional[int] = None, extra: Optional[dict] = None
+) -> Tuple[str, int, bytes]:
+    """Serialize a live filter (plain/counting/sharded/scalable) into one
+    checkpoint-format blob WITHOUT touching any sink; returns
+    ``(key_name, seq, blob)``.
+
+    Shared by :func:`save` and the replication full-resync path (the
+    primary streams these blobs to bootstrapping replicas — one format
+    for disk, Redis, and the wire). Must not run concurrently with a
+    donating insert on the same filter (caller holds the op lock)."""
     seq = seq if seq is not None else int(time.time() * 1000)
     full_extra = {**_usage_extra(filter_obj), **(extra or {})}
     if hasattr(filter_obj, "layers"):  # scalable layer stack
@@ -401,14 +605,33 @@ def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] =
             [np.asarray(layer.words) for layer in filter_obj.layers],
             full_extra,
         )
-        sink.put(filter_obj.base_config.key_name, seq, blob)
-        return seq
+        return filter_obj.base_config.key_name, seq, blob
     words = np.asarray(filter_obj.words)
-    sink.put(
-        filter_obj.config.key_name,
-        seq,
-        _serialize(filter_obj.config, seq, words, full_extra),
-    )
+    blob = _serialize(filter_obj.config, seq, words, full_extra)
+    return filter_obj.config.key_name, seq, blob
+
+
+def restore_blob(
+    blob: bytes,
+    config: Optional[FilterConfig] = None,
+    *,
+    scalable_expect: Optional[dict] = None,
+    expect_scalable: Optional[bool] = None,
+):
+    """Rebuild a live filter from one in-memory blob (integrity-checked
+    like any sink read) — the replica side of :func:`snapshot_blob`.
+    With no ``config`` the blob's own stored config is adopted (the
+    replica bootstrap case: the primary's config IS the truth)."""
+    header, payload = _deserialize(blob)
+    if config is None:
+        config = FilterConfig.from_dict(header["config"])
+    return _build_filter(config, header, payload, scalable_expect, expect_scalable)
+
+
+def save(filter_obj, sink, *, seq: Optional[int] = None, extra: Optional[dict] = None) -> int:
+    """Synchronous snapshot of any filter (plain/counting/sharded/scalable)."""
+    key_name, seq, blob = snapshot_blob(filter_obj, seq=seq, extra=extra)
+    sink.put(key_name, seq, blob)
     return seq
 
 
@@ -647,6 +870,11 @@ class AsyncCheckpointer:
         #: checkpoint landed in the sink + how long its write took
         self.last_checkpoint_time: Optional[float] = None
         self.last_checkpoint_duration_s: Optional[float] = None
+        #: the ``extra`` header of the last checkpoint that verifiably
+        #: LANDED (not merely triggered) — the replication layer reads
+        #: ``last_landed_meta["repl_seq"]`` to know how much op-log tail
+        #: is already covered by durable state and can be truncated
+        self.last_landed_meta: Optional[dict] = None
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -655,7 +883,7 @@ class AsyncCheckpointer:
             item = self._queue.get()
             if item is None:
                 return
-            seq, key_name, blob_fn = item
+            seq, key_name, blob_fn, extra = item
             t0 = time.perf_counter()
             try:
                 # blob_fn blocks until the async D2H copies land.
@@ -663,6 +891,7 @@ class AsyncCheckpointer:
                 self.checkpoints_written += 1
                 self.last_checkpoint_time = time.time()
                 self.last_checkpoint_duration_s = time.perf_counter() - t0
+                self.last_landed_meta = extra
                 self.last_error = None  # a success clears a transient failure
                 if self.retain and hasattr(self.sink, "prune"):
                     # GC AFTER a confirmed-good write: the newest file is
@@ -738,7 +967,7 @@ class AsyncCheckpointer:
                 words = _device_snapshot(self.filter.words)
                 blob_fn = lambda: _serialize(cfg, seq, np.asarray(words), extra)
                 key_name = cfg.key_name
-        self._queue.put((seq, key_name, blob_fn))
+        self._queue.put((seq, key_name, blob_fn, extra))
         return True
 
     def flush(self, timeout: float = 60.0) -> bool:

@@ -16,6 +16,11 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
 * ``ckpt.restore_read`` — inside ``FileSink.get`` before reading a blob
 * ``rpc.pre_handle``    — in the server RPC wrapper before the handler
 * ``rpc.post_handle``   — after the handler, before the response encodes
+* ``repl.append``       — inside ``OpLog.append`` before bytes are written
+* ``repl.stream_send``  — in the primary's ReplStream generator before
+  each snapshot/record send (kills a replication stream mid-batch)
+* ``repl.apply``        — in the replica/replay apply path before a
+  record's handler runs
 
 Trigger policies (``policy`` argument / env syntax):
 
@@ -62,6 +67,9 @@ KNOWN_POINTS = {
     "ckpt.restore_read",
     "rpc.pre_handle",
     "rpc.post_handle",
+    "repl.append",
+    "repl.stream_send",
+    "repl.apply",
 }
 
 MODES = ("raise", "torn")

@@ -30,6 +30,15 @@ Metric catalog (all prefixed ``tpubloom_``):
   (e.g. ``client_breaker_state``: 0 closed / 1 half-open / 2 open).
 * robustness counters (ISSUE 2): ``requests_shed_total``,
   ``delete_dedup_hits_total``, ``restores_with_corrupt_generations_total``.
+* replication (ISSUE 3, process-global): gauges ``repl_log_seq`` /
+  ``repl_log_bytes`` / ``repl_log_segments`` /
+  ``repl_connected_replicas`` / ``repl_max_replica_lag_seq`` (primary),
+  ``repl_lag_seq`` / ``repl_lag_seconds`` (replica),
+  ``retry_after_ms_current`` / ``monitor_subscribers``; counters
+  ``repl_full_resyncs_total`` / ``repl_partial_resyncs_total`` /
+  ``repl_records_streamed_total`` / ``repl_records_applied_total`` /
+  ``repl_records_skipped_total`` / ``repl_reconnects_total`` /
+  ``repl_log_torn_tail_truncated_total`` / ``monitor_events_dropped_total``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,178 @@
+"""Primary side of the replication protocol (PSYNC parity).
+
+A replica opens the server-streaming ``ReplStream`` RPC with a cursor
+(the last op seq it fully applied; absent on first contact). The
+primary answers the way Redis PSYNC does:
+
+* **full resync** — cursor absent, or the checkpoint-keyed log
+  truncation has already dropped the records past it: the primary
+  snapshots every live filter (checkpoint-format blobs, each stamped
+  with the op seq its bytes cover) and streams them, then tails the log
+  from the oldest snapshot seq. The per-filter ``applied_seq`` stamps
+  make the handoff race-free: a record the snapshot already contains is
+  skipped by the replica's seq gate, not re-applied.
+* **partial resync** — cursor still inside the log: ack and stream the
+  tail (the Redis repl-backlog case).
+
+Either way the stream then follows the live log (:meth:`OpLog.wait_for`)
+and idles with heartbeats carrying the head seq, which is what the
+replica's ``repl_lag_seq`` gauge measures against.
+
+The :class:`ReplicaSessions` hub tracks connected streams (gauge
+``repl_connected_replicas``; per-session cursors feed
+``repl_max_replica_lag_seq`` and bound log truncation so a merely-slow
+replica is not forced into a full resync).
+
+Fault point ``repl.stream_send`` fires before every snapshot/record
+send — the chaos suite kills a stream mid-batch with it and proves the
+reconnect replays nothing twice.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from tpubloom import faults
+from tpubloom.obs import counters as _counters
+
+#: How often an idle stream emits a heartbeat (seconds).
+DEFAULT_HEARTBEAT_S = 0.5
+
+#: Max records per poll round before re-checking liveness/cancellation.
+STREAM_BATCH = 256
+
+
+class ReplicaSessions:
+    """Connected-replica registry: addresses, cursors, lag gauges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._sessions: dict[int, dict] = {}
+
+    def register(self, peer: str) -> int:
+        with self._lock:
+            sid = next(self._ids)
+            self._sessions[sid] = {
+                "peer": peer,
+                "cursor": 0,
+                "connected_at": time.time(),
+            }
+            n = len(self._sessions)
+        _counters.set_gauge("repl_connected_replicas", n)
+        return sid
+
+    def update(self, sid: int, cursor: int, head: int) -> None:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                sess["cursor"] = cursor
+            lags = [head - s["cursor"] for s in self._sessions.values()]
+        _counters.set_gauge(
+            "repl_max_replica_lag_seq", max(lags) if lags else 0
+        )
+
+    def unregister(self, sid: int) -> None:
+        with self._lock:
+            self._sessions.pop(sid, None)
+            n = len(self._sessions)
+        _counters.set_gauge("repl_connected_replicas", n)
+        if not n:
+            _counters.set_gauge("repl_max_replica_lag_seq", 0)
+
+    def min_cursor(self) -> int | None:
+        """Slowest connected replica's cursor (None when no replicas) —
+        log truncation stays behind it so live streams never lose their
+        tail mid-flight."""
+        with self._lock:
+            if not self._sessions:
+                return None
+            return min(s["cursor"] for s in self._sessions.values())
+
+    def describe(self) -> list:
+        with self._lock:
+            return [dict(s) for s in self._sessions.values()]
+
+
+def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEARTBEAT_S):
+    """Generator behind the ``ReplStream`` RPC (dicts; the server layer
+    msgpack-encodes each one)."""
+    oplog = service.oplog
+    if oplog is None:
+        yield {
+            "kind": "error",
+            "code": "UNSUPPORTED",
+            "message": "this server has no op log (start it with "
+            "--repl-log-dir to serve replicas)",
+        }
+        return
+    sessions: ReplicaSessions = service.repl_sessions
+    cursor = req.get("cursor")
+    sid = sessions.register(getattr(context, "peer", lambda: "?")())
+    try:
+        # a cursor is only resumable against the SAME log identity
+        # (Redis replid parity): a rewound/recreated log reuses seq
+        # numbers, so a stale-id cursor would silently swallow records
+        if (
+            cursor is None
+            or req.get("log_id") != oplog.log_id
+            or not oplog.has_cursor(cursor)
+        ):
+            _counters.incr("repl_full_resyncs")
+            names, snaps, plan_seq = service.snapshot_plan()
+            yield {
+                "kind": "full_sync_begin",
+                "filters": names,
+                "seq": oplog.last_seq,
+                "log_id": oplog.log_id,
+            }
+            seqs = [plan_seq]
+            for name, blob, applied_seq in snaps:
+                faults.fire("repl.stream_send")
+                yield {
+                    "kind": "snapshot",
+                    "name": name,
+                    "blob": blob,
+                    "applied_seq": applied_seq,
+                }
+                seqs.append(applied_seq)
+            # tail from the oldest snapshot point, clamped to the log
+            # head AT PLAN TIME: a create committed after the plan froze
+            # is not in `names`, so its record must be streamed — while
+            # records a snapshot already contains are skipped by the
+            # replica's per-filter gate
+            cursor = min(seqs)
+            yield {
+                "kind": "full_sync_end",
+                "cursor": cursor,
+                "log_id": oplog.log_id,
+            }
+        else:
+            _counters.incr("repl_partial_resyncs")
+            yield {
+                "kind": "partial_sync",
+                "cursor": cursor,
+                "log_id": oplog.log_id,
+            }
+        sessions.update(sid, cursor, oplog.last_seq)
+        follower = oplog.follower(cursor)
+        while context.is_active() and not service.draining:
+            batch = follower.next_batch(STREAM_BATCH)
+            for rec in batch:
+                faults.fire("repl.stream_send")
+                yield {"kind": "record", **rec}
+                _counters.incr("repl_records_streamed")
+            cursor = follower.cursor
+            sessions.update(sid, cursor, oplog.last_seq)
+            if not batch and not oplog.wait_for(
+                cursor + 1, timeout=heartbeat_s
+            ):
+                yield {
+                    "kind": "heartbeat",
+                    "seq": oplog.last_seq,
+                    "ts": time.time(),
+                }
+    finally:
+        sessions.unregister(sid)

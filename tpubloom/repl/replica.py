@@ -1,0 +1,257 @@
+"""Replica side: consume a primary's ``ReplStream`` and apply it.
+
+``python -m tpubloom.server --replica-of host:port`` runs the normal
+server read-only (writes get ``READONLY``, Redis parity) with one
+:class:`ReplicaApplier` thread behind it:
+
+* **sync** — first contact sends no cursor → full resync (snapshot blobs
+  install via :meth:`BloomService.install_snapshot`, then the log tail);
+  reconnects send the last fully-applied seq → partial resync when the
+  primary still has the tail, a fresh full resync otherwise.
+* **idempotent apply** — every record is gated twice: the stream-global
+  cursor (records at or below it are never re-requested) and the
+  per-filter ``applied_seq`` (a record already contained in an installed
+  snapshot is skipped, counted in ``repl_records_skipped``). Killing the
+  stream mid-batch and reconnecting therefore re-applies nothing — the
+  chaos suite pins this with the ``repl.stream_send``/``repl.apply``
+  fault points.
+* **lag** — ``repl_lag_seq`` (head seq from records/heartbeats minus the
+  applied cursor) and ``repl_lag_seconds`` (apply-time minus the
+  record's primary commit time; 0 when caught up on a heartbeat).
+* **liveness** — transport errors back off exponentially
+  (``repl_reconnects``); the link state lands in Health via
+  :meth:`status` (``link: connected/connecting/lost``).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from tpubloom.obs import counters as _counters
+from tpubloom.server import protocol
+
+log = logging.getLogger("tpubloom.repl")
+
+
+class FullResyncNeeded(Exception):
+    """Raised by the apply path when a record's effect cannot be derived
+    from the stream alone — e.g. a ``CreateFilter`` that bootstrapped
+    state from a checkpoint the replica does not have. The applier drops
+    its cursor and reconnects: the full-resync snapshot carries the
+    state the record could not."""
+
+    def __init__(self, name: str):
+        super().__init__(
+            f"record for filter {name!r} references state only a full "
+            f"resync can transfer"
+        )
+        self.name = name
+
+
+class ReplicaApplier:
+    """Background thread that keeps a local (read-only) service in sync
+    with a primary."""
+
+    def __init__(
+        self,
+        service,
+        primary_address: str,
+        *,
+        reconnect_base: float = 0.2,
+        reconnect_max: float = 5.0,
+    ):
+        self.service = service
+        self.primary_address = primary_address
+        self.reconnect_base = reconnect_base
+        self.reconnect_max = reconnect_max
+        #: last op seq fully applied (the reconnect cursor); None until
+        #: the first successful sync
+        self.cursor: Optional[int] = None
+        #: the primary log identity the cursor belongs to (Redis replid
+        #: parity) — echoed on reconnect; a primary whose log identity
+        #: changed (rewound/recreated) answers with a full resync
+        self.log_id: Optional[str] = None
+        self.head_seq = 0
+        self.link = "connecting"
+        self.full_syncs = 0
+        self.partial_syncs = 0
+        self.records_applied = 0
+        self.records_skipped = 0
+        self.last_sync_kind: Optional[str] = None
+        self._stop = threading.Event()
+        self._call = None
+        self._call_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, name="tpubloom-replica", daemon=True
+        )
+        service.replica_applier = self
+        service.primary_address = primary_address
+
+    def start(self) -> "ReplicaApplier":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._call_lock:
+            if self._call is not None:
+                self._call.cancel()
+        self._thread.join(timeout=timeout)
+
+    def status(self) -> dict:
+        return {
+            "primary": self.primary_address,
+            "link": self.link,
+            "cursor": self.cursor,
+            "log_id": self.log_id,
+            "head_seq": self.head_seq,
+            "lag_seq": max(0, self.head_seq - (self.cursor or 0)),
+            "full_syncs": self.full_syncs,
+            "partial_syncs": self.partial_syncs,
+            "records_applied": self.records_applied,
+            "records_skipped": self.records_skipped,
+        }
+
+    def wait_caught_up(self, timeout: float = 30.0, poll: float = 0.02) -> bool:
+        """Test/operator helper: block until lag_seq == 0 after at least
+        one successful sync. NOTE: ``head_seq`` is the newest seq the
+        *replica has heard of* — a write committed on the primary a
+        moment ago may not be in it yet; to wait for a specific write
+        use :meth:`wait_for_seq` with the primary's log seq."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (
+                self.cursor is not None
+                and self.link == "connected"
+                and self.head_seq <= self.cursor
+            ):
+                return True
+            time.sleep(poll)
+        return False
+
+    def wait_for_seq(self, seq: int, timeout: float = 30.0, poll: float = 0.02) -> bool:
+        """Block until the replica has applied (or skipped as already
+        contained) every record up to ``seq`` — the read-your-writes
+        barrier: pass the primary's log seq after a write."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.cursor is not None and self.cursor >= seq:
+                return True
+            time.sleep(poll)
+        return False
+
+    # -- stream loop ---------------------------------------------------------
+
+    def _run(self) -> None:
+        attempt = 0
+        while not self._stop.is_set():
+            channel = grpc.insecure_channel(
+                self.primary_address,
+                options=[
+                    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ],
+            )
+            stream_call = channel.unary_stream(
+                protocol.method_path("ReplStream"),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            req: dict = {}
+            if self.cursor is not None:
+                req["cursor"] = self.cursor
+                req["log_id"] = self.log_id
+            try:
+                self.link = "connecting"
+                call = stream_call(protocol.encode(req), timeout=None)
+                with self._call_lock:
+                    self._call = call
+                for raw in call:
+                    attempt = 0  # any delivered message resets backoff
+                    self._handle(protocol.decode(raw))
+                    if self._stop.is_set():
+                        break
+            except FullResyncNeeded as e:
+                log.info(
+                    "replication: %s — dropping cursor for a full resync", e
+                )
+                self.cursor = None
+                attempt = 0
+            except grpc.RpcError as e:
+                if not self._stop.is_set():
+                    code = getattr(e, "code", lambda: None)()
+                    log.warning(
+                        "replication stream to %s lost (%s); reconnecting",
+                        self.primary_address, code,
+                    )
+            except Exception:
+                log.exception("replication apply failed; reconnecting")
+            finally:
+                with self._call_lock:
+                    self._call = None
+                channel.close()
+            if self._stop.is_set():
+                break
+            self.link = "lost"
+            _counters.incr("repl_reconnects")
+            delay = min(
+                self.reconnect_max, self.reconnect_base * (2 ** attempt)
+            ) * (0.5 + random.random())
+            attempt += 1
+            self._stop.wait(delay)
+        self.link = "stopped"
+
+    def _handle(self, msg: dict) -> None:
+        kind = msg.get("kind")
+        if kind == "full_sync_begin":
+            self.link = "syncing"
+            self.last_sync_kind = "full"
+            self.full_syncs += 1
+            self.head_seq = msg["seq"]
+            self._sync_filters = list(msg.get("filters", ()))
+        elif kind == "snapshot":
+            self.service.install_snapshot(
+                msg["name"], msg["blob"], msg["applied_seq"]
+            )
+        elif kind == "full_sync_end":
+            # drop local filters the primary no longer has — a full
+            # resync is a state reset, not a merge
+            self.service.retain_only(self._sync_filters)
+            self.cursor = msg["cursor"]
+            self.log_id = msg.get("log_id")
+            self.link = "connected"
+        elif kind == "partial_sync":
+            self.last_sync_kind = "partial"
+            self.partial_syncs += 1
+            self.cursor = msg["cursor"]
+            self.log_id = msg.get("log_id")
+            self.link = "connected"
+        elif kind == "record":
+            applied = self.service.apply_record(msg)
+            if applied:
+                self.records_applied += 1
+                _counters.incr("repl_records_applied")
+            else:
+                self.records_skipped += 1
+                _counters.incr("repl_records_skipped")
+            self.cursor = msg["seq"]
+            self.head_seq = max(self.head_seq, msg["seq"])
+            _counters.set_gauge(
+                "repl_lag_seconds", max(0.0, time.time() - msg.get("ts", 0))
+            )
+        elif kind == "heartbeat":
+            self.head_seq = max(self.head_seq, msg["seq"])
+            if self.cursor is not None and self.head_seq <= self.cursor:
+                _counters.set_gauge("repl_lag_seconds", 0.0)
+        elif kind == "error":
+            raise protocol.BloomServiceError(
+                msg.get("code", "UNKNOWN"), msg.get("message", "")
+            )
+        _counters.set_gauge(
+            "repl_lag_seq", max(0, self.head_seq - (self.cursor or 0))
+        )

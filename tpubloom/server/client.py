@@ -32,6 +32,26 @@ and leaves retry to the caller, the new framework does better):
   process gauge ``client_breaker_state`` (0 closed / 1 half-open /
   2 open).
 
+Replication-awareness (ISSUE 3):
+
+* **read-preference routing** — construct with ``replicas=[addr, ...],
+  read_preference="replica"`` and ``QueryBatch`` traffic round-robins
+  over the read replicas (writes ALWAYS go to the primary). A replica
+  that fails (down, lagging NOT_FOUND, READONLY confusion) falls back
+  to the primary for that call — counted in
+  ``client_replica_fallbacks`` — so replica loss degrades to primary
+  reads, never to errors.
+* **READONLY redirect** — a write answered with ``READONLY`` (the
+  configured "primary" is actually a replica, e.g. mid-failover) is
+  retried once against the primary address the replica's error details
+  advertise (Redis MOVED-style), transparently re-pointing the client.
+* **retryable non-idempotent inserts** — counting/scalable/presence
+  inserts are now auto-retried on ``UNAVAILABLE`` like DeleteBatch:
+  retries reuse the logical call's rid and the server answers a replay
+  whose first attempt landed from its rid→response cache instead of
+  double-applying. (Servers older than ISSUE 3 do not cache inserts —
+  pin ``max_retries=0`` per call-site if you must talk to one.)
+
 Observability: every RPC is stamped with a generated request id
 (``self.last_rid`` after the call) which the server folds into its
 profiler spans and slowlog entries — ``slowlog_get()`` entries carry the
@@ -56,6 +76,16 @@ from tpubloom.server import protocol
 #: error codes meaning "the server refused BEFORE running the handler" —
 #: replaying is safe for every method, idempotent or not
 _SHED_CODES = frozenset({"RESOURCE_EXHAUSTED", "DRAINING"})
+
+#: methods eligible for replica routing under read_preference="replica".
+#: Deliberately narrow: Stats/Slowlog are per-host diagnostics (you want
+#: the host you asked), Health is a liveness probe of its target.
+_REPLICA_READS = frozenset({"QueryBatch"})
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+]
 
 _BREAKER_GAUGE = {"closed": 0, "half-open": 1, "open": 2}
 
@@ -150,6 +180,27 @@ class CircuitBreaker:
                 obs_counters.incr("breaker_opened")
 
 
+class ServerStream:
+    """Iterable over one server-streaming RPC, decoding each msgpack
+    frame; ``cancel()`` tears the stream down (safe mid-iteration)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def __iter__(self):
+        for raw in self._call:
+            yield protocol.decode(raw)
+
+    def cancel(self) -> None:
+        self._call.cancel()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.cancel()
+
+
 class BloomClient:
     """Blocking client; one instance per channel, filters addressed by name."""
 
@@ -163,24 +214,41 @@ class BloomClient:
         backoff_max: float = 5.0,
         breaker_threshold: int = 5,
         breaker_cooldown: float = 5.0,
+        replicas: Optional[Sequence[str]] = None,
+        read_preference: str = "primary",
     ):
+        """``replicas`` + ``read_preference="replica"`` route QueryBatch
+        traffic round-robin over read replicas (writes always hit
+        ``address``); a failing replica falls back to the primary for
+        that call."""
+        if read_preference not in ("primary", "replica"):
+            raise ValueError(
+                f"read_preference must be 'primary' or 'replica', "
+                f"got {read_preference!r}"
+            )
         self.address = address
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        self.read_preference = read_preference
         self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
         self.last_rid: Optional[str] = None
         self._creations: dict[str, dict] = {}
-        self._channel = grpc.insecure_channel(
-            address,
-            options=[
-                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                ("grpc.max_send_message_length", 256 * 1024 * 1024),
-            ],
-        )
-        self._calls = {
-            m: self._channel.unary_unary(
+        self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+        self._calls = self._make_calls(self._channel)
+        self._stream_calls = self._make_stream_calls(self._channel)
+        #: (address, channel, calls) per read replica, round-robined
+        self._replicas: list = []
+        for addr in replicas or ():
+            ch = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+            self._replicas.append((addr, ch, self._make_calls(ch)))
+        self._rr = 0
+
+    @staticmethod
+    def _make_calls(channel) -> dict:
+        return {
+            m: channel.unary_unary(
                 protocol.method_path(m),
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b,
@@ -188,47 +256,68 @@ class BloomClient:
             for m in protocol.METHODS
         }
 
-    def _call_once(self, method: str, req: dict) -> dict:
-        raw = self._calls[method](protocol.encode(req), timeout=self.timeout)
+    @staticmethod
+    def _make_stream_calls(channel) -> dict:
+        return {
+            m: channel.unary_stream(
+                protocol.method_path(m),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            for m in protocol.STREAM_METHODS
+        }
+
+    def _call_once(self, method: str, req: dict, calls=None) -> dict:
+        calls = self._calls if calls is None else calls
+        raw = calls[method](protocol.encode(req), timeout=self.timeout)
         return protocol.check(protocol.decode(raw))
 
-    def _maybe_nonidempotent_insert(self, name: str) -> bool:
-        """True unless a replayed insert on this filter is KNOWN harmless.
+    def _try_replica(self, method: str, req: dict) -> Optional[dict]:
+        """One replica attempt for a routed read; None = fall back to the
+        primary path (replica down, still syncing, or otherwise unable)."""
+        if (
+            not self._replicas
+            or self.read_preference != "replica"
+            or method not in _REPLICA_READS
+        ):
+            return None
+        self._rr = (self._rr + 1) % len(self._replicas)
+        addr, _, calls = self._replicas[self._rr]
+        try:
+            return self._call_once(method, req, calls)
+        except (grpc.RpcError, protocol.BloomServiceError):
+            # includes NOT_FOUND from a replica that has not yet synced
+            # the filter — the primary answers authoritatively
+            obs_counters.incr("client_replica_fallbacks")
+            return None
 
-        Filters not created through this client (e.g. attached by name
-        after another process made them) have unknown type — treated as
-        non-idempotent, i.e. their inserts are never auto-retried.
-        Counting inserts are scatter-ADDs (a landed replay
-        double-increments); scalable inserts double-count layer fill,
-        growing layers at half occupancy."""
-        creation = self._creations.get(name)
-        if creation is None:
-            return True
-        return bool(
-            creation.get("config", {}).get("counting")
-            or creation.get("options", {}).get("counting")
-            or creation.get("scalable")
-        )
+    def _follow_primary(self, address: str) -> None:
+        """READONLY redirect: re-point the primary channel (the old
+        channel is closed; replica channels are untouched)."""
+        old = self._channel
+        self.address = address
+        self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+        self._calls = self._make_calls(self._channel)
+        self._stream_calls = self._make_stream_calls(self._channel)
+        old.close()
+        obs_counters.incr("client_primary_redirects")
 
-    def _rpc(self, method: str, req: dict, *, force_no_retry: bool = False) -> dict:
-        # fail fast while the breaker is open — no network, no backoff
-        self.breaker.check(self.address)
+    def _rpc(self, method: str, req: dict) -> dict:
         # request-correlation id: one per LOGICAL call (retries and the
         # NOT_FOUND heal's final retry share it); exposed as last_rid so
         # callers can find their request in the server slowlog/trace.
-        # DeleteBatch retries lean on this id: the server's dedup cache
-        # answers a replayed rid from cache instead of re-applying.
+        # DeleteBatch and non-idempotent InsertBatch retries lean on this
+        # id: the server's dedup cache answers a replayed rid from cache
+        # instead of re-applying.
         self.last_rid = rid = new_rid()
         req = {**req, "rid": rid}
-        # Counting-filter inserts are scatter-ADDs, not idempotent OR —
-        # a replayed insert that DID land double-increments counters, so a
-        # later delete leaves residue (stuck false positives).
-        no_retry = force_no_retry or (
-            method == "InsertBatch"
-            and self._maybe_nonidempotent_insert(req.get("name", ""))
-        )
-        retries = 0 if no_retry else self.max_retries
+        routed = self._try_replica(method, req)
+        if routed is not None:
+            return routed
+        # fail fast while the breaker is open — no network, no backoff
+        self.breaker.check(self.address)
         recreated = False
+        redirected = False
         attempt = 0
         shed_attempt = 0
         while True:
@@ -239,7 +328,7 @@ class BloomClient:
             except grpc.RpcError as e:
                 if (
                     e.code() is not grpc.StatusCode.UNAVAILABLE
-                    or attempt >= retries
+                    or attempt >= self.max_retries
                 ):
                     # one LOGICAL failure (own retries exhausted) = one
                     # breaker strike — patient riders don't trip it
@@ -268,6 +357,16 @@ class BloomClient:
                         delay = max(delay, hint_ms / 1000.0)
                     time.sleep(delay * (0.75 + random.random() / 2))
                     shed_attempt += 1
+                    continue
+                if e.code == "READONLY" and not redirected:
+                    # the "primary" we were pointed at is a replica
+                    # (failover, stale config). Its error advertises the
+                    # real primary — follow it once, Redis-MOVED-style.
+                    primary = e.details.get("primary")
+                    if not primary or primary == self.address:
+                        raise
+                    self._follow_primary(primary)
+                    redirected = True
                     continue
                 # Heal a restarted server: replay the remembered creation
                 # (restores the newest checkpoint), then retry the op once.
@@ -428,9 +527,10 @@ class BloomClient:
         if not return_presence:
             return self._rpc("InsertBatch", req)["n"]
         req["return_presence"] = True
-        # never auto-retried: a replay after an insert that DID land
-        # would report the batch's own keys as pre-existing duplicates
-        resp = self._rpc("InsertBatch", req, force_no_retry=True)
+        # retryable since ISSUE 3: retries reuse the rid and the server
+        # answers a replay whose first attempt landed from its dedup
+        # cache (same machinery as DeleteBatch), presence bits included
+        resp = self._rpc("InsertBatch", req)
         return self._unpack_bool(resp, "presence")
 
     @staticmethod
@@ -483,8 +583,30 @@ class BloomClient:
         """Clear the server slowlog; returns how many entries dropped."""
         return self._rpc("SlowlogReset", {})["cleared"]
 
+    def monitor(self, name: Optional[str] = None) -> "ServerStream":
+        """Redis ``MONITOR`` parity: a live stream of every request the
+        server finishes, as dicts (``kind: hello/op/heartbeat``), with
+        optional per-filter-name filtering (which MONITOR itself cannot
+        do). Iterate the returned stream; ``.cancel()`` to stop."""
+        req = {"name": name} if name else {}
+        return ServerStream(
+            self._stream_calls["Monitor"](protocol.encode(req), timeout=None)
+        )
+
+    def repl_stream(self, cursor: Optional[int] = None) -> "ServerStream":
+        """Raw access to the replication changefeed (what a replica
+        consumes): ``full_sync_begin/snapshot/full_sync_end/partial_sync/
+        record/heartbeat`` frames. Mostly for tooling/tests — run a real
+        replica with ``python -m tpubloom.server --replica-of``."""
+        req = {"cursor": cursor} if cursor is not None else {}
+        return ServerStream(
+            self._stream_calls["ReplStream"](protocol.encode(req), timeout=None)
+        )
+
     def close(self) -> None:
         self._channel.close()
+        for _, ch, _ in self._replicas:
+            ch.close()
 
     def __enter__(self):
         return self

@@ -42,6 +42,23 @@ METHODS = (
     "SlowlogReset",
 )
 
+#: Server-streaming RPCs (ISSUE 3): each response frame is one msgpack
+#: map. ``ReplStream`` is the primary→replica changefeed (PSYNC parity:
+#: request ``{cursor?}``, frames ``full_sync_begin/snapshot/
+#: full_sync_end/partial_sync/record/heartbeat``); ``Monitor`` is the
+#: Redis-MONITOR-parity live op stream (request ``{name?}`` to filter by
+#: filter name, frames ``hello/op/heartbeat``).
+STREAM_METHODS = (
+    "ReplStream",
+    "Monitor",
+)
+
+#: Mutating RPCs: replicated through the op log, rejected with
+#: ``READONLY`` on replicas (Redis ``replica-read-only`` parity).
+MUTATING_METHODS = frozenset(
+    {"CreateFilter", "DropFilter", "InsertBatch", "DeleteBatch", "Clear"}
+)
+
 
 def encode(msg: dict) -> bytes:
     return msgpack.packb(msg, use_bin_type=True)

@@ -39,11 +39,40 @@ Robustness (ISSUE 2):
 * **fault points** — ``rpc.pre_handle`` / ``rpc.post_handle``
   (:mod:`tpubloom.faults`) let the chaos suite simulate handler crashes
   and response-lost-after-apply without patching internals.
+
+Replication (ISSUE 3 — :mod:`tpubloom.repl`):
+
+* **op log** — with an :class:`tpubloom.repl.OpLog` attached
+  (``--repl-log-dir``), every mutating RPC appends one CRC32C-framed
+  record at its commit point (under the filter's op lock, so log order
+  equals apply order per filter). Startup replays the log over the
+  restored checkpoints — per-filter ``repl_seq`` stamps in checkpoint
+  headers gate the replay, so acked writes survive a crash even when
+  the checkpoint lagged (AOF parity), and nothing applies twice.
+  Checkpoint-keyed truncation keeps only the tail the checkpoints do
+  not yet cover (bounded additionally by the slowest connected
+  replica's cursor).
+* **primary→replica streaming** — the ``ReplStream`` RPC
+  (:mod:`tpubloom.repl.primary`) serves full resyncs (live-filter
+  snapshot blobs + log tail) and partial resyncs (cursor still in the
+  log), PSYNC-style; connected replicas and their lag are gauges.
+* **read replicas** — ``read_only=True`` (``--replica-of host:port``)
+  rejects every mutating RPC with ``READONLY`` (Redis parity) while a
+  :class:`tpubloom.repl.ReplicaApplier` keeps local state in sync;
+  reads/health/stats serve normally.
+* **MONITOR parity** — the ``Monitor`` streaming RPC tails every
+  finished request (optionally filtered per filter name) off the same
+  commit points, via :class:`tpubloom.repl.MonitorHub`.
+* **adaptive retry hints** — shed responses carry a ``retry_after_ms``
+  that grows with the observed shed rate (the measurable queue-pressure
+  signal once the in-flight cap is pegged) and decays back to the
+  configured base when the burst passes.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 from collections import OrderedDict
@@ -60,6 +89,9 @@ from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.obs import context as obs
 from tpubloom.obs.slowlog import Slowlog, summarize_request
+from tpubloom.repl import monitor as repl_monitor
+from tpubloom.repl import primary as repl_primary
+from tpubloom.repl.replica import FullResyncNeeded
 from tpubloom.server import protocol
 from tpubloom.server.metrics import Metrics
 from tpubloom.utils import tracing
@@ -73,13 +105,23 @@ class _Managed:
 
         self.filter = filt
         self.lock = threading.Lock()
+        #: newest op-log seq whose effect this filter's state contains —
+        #: advanced at every logged commit, persisted into checkpoint
+        #: headers (``repl_seq``), and used to gate replay/stream apply
+        #: to exactly-once semantics
+        self.applied_seq = 0
         # fused test-and-insert capability is a static property of the
         # filter class — probe once, not per InsertBatch request
         self.supports_presence = (
             "return_presence" in inspect.signature(filt.insert_batch).parameters
         )
         self.checkpointer = (
-            ckpt.AsyncCheckpointer(filt, sink, every_n_inserts=checkpoint_every)
+            ckpt.AsyncCheckpointer(
+                filt,
+                sink,
+                every_n_inserts=checkpoint_every,
+                meta_fn=lambda: {"repl_seq": self.applied_seq},
+            )
             if sink is not None
             else None
         )
@@ -96,6 +138,15 @@ UNSHEDDABLE = frozenset(
 #: degraded reason — long enough for a scraper/prober to catch a burst.
 SHED_DEGRADED_WINDOW_S = 5.0
 
+#: Adaptive retry_after_ms (ISSUE 3 satellite): the shed-pressure term
+#: decays with this time constant, and the hint never exceeds
+#: base * RETRY_AFTER_CAP_FACTOR.
+PRESSURE_DECAY_S = 1.0
+RETRY_AFTER_CAP_FACTOR = 32
+
+#: Commit-point appends between checkpoint-keyed log-truncation sweeps.
+TRUNCATE_EVERY_APPENDS = 64
+
 
 class BloomService:
     """Method handlers; state = {name: _Managed}."""
@@ -108,13 +159,20 @@ class BloomService:
         max_in_flight: Optional[int] = None,
         retry_after_ms: int = 50,
         dedup_capacity: int = 1024,
+        oplog=None,
+        read_only: bool = False,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
         ``max_in_flight`` caps concurrently-executing sheddable RPCs
-        (None/0 = unbounded); shed responses carry ``retry_after_ms``.
-        ``dedup_capacity`` bounds the rid→response replay cache that makes
-        DeleteBatch safely retryable (0 disables it)."""
+        (None/0 = unbounded); shed responses carry a ``retry_after_ms``
+        hint that starts at the configured base and grows with the shed
+        rate. ``dedup_capacity`` bounds the rid→response replay cache
+        that makes DeleteBatch (and non-idempotent InsertBatch) safely
+        retryable (0 disables it). ``oplog`` attaches a
+        :class:`tpubloom.repl.OpLog` (this process becomes a replication
+        primary + AOF-durable); ``read_only=True`` makes it a replica
+        (mutating RPCs answer ``READONLY``)."""
         self._filters: dict[str, _Managed] = {}
         self._lock = threading.Lock()
         self._sink_factory = sink_factory or (lambda config: None)
@@ -126,12 +184,36 @@ class BloomService:
         self._admit_lock = threading.Lock()
         self._draining = False
         self._last_shed_time = 0.0
+        #: decaying shed-rate pressure (events, half-life ~PRESSURE_DECAY_S)
+        #: — the adaptive component of retry_after_ms
+        self._shed_pressure = 0.0
+        self._pressure_updated = time.monotonic()
         self._dedup_capacity = dedup_capacity
         self._dedup: "OrderedDict[str, dict]" = OrderedDict()
         self._dedup_lock = threading.Lock()
         #: filter name -> time a corrupt checkpoint was detected during its
         #: restore; cleared once a good checkpoint lands after that moment
         self._ckpt_corrupt_seen: dict[str, float] = {}
+        # -- replication (ISSUE 3) --
+        self.oplog = oplog
+        self.read_only = read_only
+        self.repl_sessions = repl_primary.ReplicaSessions()
+        self.monitor_hub = repl_monitor.MonitorHub()
+        #: set by ReplicaApplier when this process follows a primary
+        self.replica_applier = None
+        self.primary_address: Optional[str] = None
+        #: True while replay_oplog runs — replayed ops must not re-append
+        self._replaying = False
+        self._appends_since_truncate = 0
+        #: set (repr of the exception) when an op-log append fails AFTER
+        #: its op applied in memory — state is now ahead of the log, so
+        #: further writes are fail-stopped (Redis aborts writes on AOF
+        #: write errors the same way) until an operator restarts
+        self.oplog_error: Optional[str] = None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # -- helpers -------------------------------------------------------------
 
@@ -167,10 +249,28 @@ class BloomService:
                 self._in_flight += 1
                 return None
             self._last_shed_time = time.time()
+            retry_ms = self._bump_shed_pressure()
         self.metrics.count("requests_shed")
         return protocol.error_response(
-            shed_code, shed_msg, details={"retry_after_ms": self.retry_after_ms}
+            shed_code, shed_msg, details={"retry_after_ms": retry_ms}
         )
+
+    def _bump_shed_pressure(self) -> int:
+        """Adaptive retry hint (caller holds ``_admit_lock``): the first
+        shed of a burst answers the configured base; each further shed
+        while the pressure has not decayed grows the hint, so a thundering
+        herd spreads itself out instead of re-colliding — with the
+        in-flight cap pegged, the shed rate IS the queue-depth signal."""
+        now = time.monotonic()
+        self._shed_pressure *= math.exp(
+            -(now - self._pressure_updated) / PRESSURE_DECAY_S
+        )
+        self._pressure_updated = now
+        hint = self.retry_after_ms * (1.0 + self._shed_pressure)
+        self._shed_pressure += 1.0
+        hint = int(min(hint, self.retry_after_ms * RETRY_AFTER_CAP_FACTOR))
+        obs_counters.set_gauge("retry_after_ms_current", hint)
+        return hint
 
     def release(self, method: str) -> None:
         if method in UNSHEDDABLE:
@@ -183,6 +283,238 @@ class BloomService:
         reporting DRAINING); in-flight requests run to completion."""
         with self._admit_lock:
             self._draining = True
+
+    # -- replication: op log, apply, snapshots (ISSUE 3) ---------------------
+
+    def _log_op(
+        self,
+        method: str,
+        req: dict,
+        mf: Optional[_Managed] = None,
+        *,
+        may_truncate: bool = True,
+    ) -> None:
+        """Append one committed mutating op to the op log (no-op without
+        a log, and during replay). MUST be called while still holding the
+        lock the op committed under — log order is apply order.
+        ``may_truncate=False`` for callers holding ``self._lock``
+        (Create/Drop): the truncation sweep re-takes it and the lock is
+        not re-entrant — their sweep runs on a later data-plane append."""
+        if self.oplog is None or self._replaying:
+            return
+        try:
+            seq = self.oplog.append(method, req, rid=obs.current_rid())
+        except Exception as e:
+            # the op ALREADY applied in memory: this process is now ahead
+            # of its own log. Fail-stop further writes (reads keep
+            # serving) — silently continuing would diverge replicas and
+            # crash-replay state with no signal.
+            self.oplog_error = repr(e)
+            obs_counters.incr("repl_log_append_errors")
+            log.exception(
+                "op log append failed for %s — write path fail-stopped",
+                method,
+            )
+            raise
+        if mf is not None:
+            mf.applied_seq = seq
+        self._appends_since_truncate += 1
+        if may_truncate and self._appends_since_truncate >= TRUNCATE_EVERY_APPENDS:
+            self._appends_since_truncate = 0
+            self._maybe_truncate_log()
+
+    def _maybe_truncate_log(self) -> None:
+        """Checkpoint-keyed log GC: records every filter's newest LANDED
+        checkpoint already covers are replayable from checkpoints alone
+        and can go — bounded by the slowest connected replica's cursor so
+        a live stream never loses its tail (backlog parity)."""
+        oplog = self.oplog
+        if oplog is None:
+            return
+        with self._lock:
+            mfs = list(self._filters.values())
+        safe = oplog.last_seq  # no filters: empty state replays from nothing
+        for mf in mfs:
+            if mf.checkpointer is None:
+                return  # unpersisted filter: its whole history must stay
+            meta = mf.checkpointer.last_landed_meta
+            if meta is None:
+                return  # nothing landed yet for this filter
+            safe = min(safe, int(meta.get("repl_seq") or 0))
+        replica_floor = self.repl_sessions.min_cursor()
+        if replica_floor is not None:
+            safe = min(safe, replica_floor)
+        if oplog.truncate_to(safe):
+            self.metrics.count("repl_log_truncations")
+
+    def apply_record(self, rec: dict) -> bool:
+        """Apply one op-log record (startup replay on a primary, stream
+        apply on a replica); True iff it changed state, False when the
+        per-filter seq gate proved the effect already present. Exactly
+        the idempotence the acceptance test pins: killing a stream
+        mid-batch and replaying the records cannot double-apply."""
+        faults.fire("repl.apply")
+        method, seq = rec["method"], rec["seq"]
+        req = dict(rec["req"])
+        if rec.get("rid"):
+            req["rid"] = rec["rid"]
+        name = req.get("name")
+        if method == "CreateFilter":
+            restored_seq = req.pop("restored_seq", None)
+            mf = self._filters.get(name)
+            if mf is not None and mf.applied_seq >= seq:
+                return False
+            if self.read_only:
+                if restored_seq is not None:
+                    # the primary bootstrapped this filter from a
+                    # checkpoint generation the replica does not have —
+                    # no sequence of records reproduces those bytes
+                    raise FullResyncNeeded(name)
+                # a FRESH create on the primary must be fresh here too:
+                # restore-on-create would resurrect the replica's own
+                # stale local checkpoint of a previous same-name filter
+                req["restore"] = False
+            self.CreateFilter({**req, "exist_ok": True})
+            mf = self._filters.get(name)
+            if mf is not None:
+                mf.applied_seq = max(mf.applied_seq, seq)
+            return True
+        if method == "DropFilter":
+            mf = self._filters.get(name)
+            if mf is not None and mf.applied_seq >= seq:
+                # the live filter is NEWER than this drop (a full-resync
+                # snapshot installed the re-created filter): dropping it
+                # would delete state the later records cannot rebuild
+                return False
+            return bool(self.DropFilter(req).get("existed"))
+        mf = self._filters.get(name)
+        if mf is None:
+            log.warning(
+                "op-log record seq %d (%s) names unknown filter %r; skipped",
+                seq, method, name,
+            )
+            return False
+        if mf.applied_seq >= seq:
+            return False
+        # advance BEFORE the handler runs (mirror of the live path's
+        # log-before-notify ordering): a checkpoint the handler triggers
+        # via notify_inserts must stamp THIS record's seq, or a second
+        # crash replays the record past its own checkpoint
+        prev = mf.applied_seq
+        mf.applied_seq = seq
+        try:
+            getattr(self, method)(req)
+        except Exception:
+            mf.applied_seq = prev
+            raise
+        return True
+
+    def replay_oplog(self) -> dict:
+        """Startup replay (primary with ``--repl-log-dir``): re-drive
+        every logged op over the checkpoint-restored state. The
+        per-filter ``repl_seq`` gates make this idempotent — AOF parity:
+        acked writes newer than the last checkpoint come back."""
+        if self.oplog is None:
+            return {"applied": 0, "skipped": 0, "failed": 0}
+        applied = skipped = failed = 0
+        restored_from_manifest = 0
+        self._replaying = True
+        try:
+            # manifest first: filters whose CreateFilter record was
+            # truncated away (covered by a landed checkpoint) come back
+            # via restore-on-create before the record tail replays
+            for name, create_req in (self._manifest_read() or {}).items():
+                try:
+                    self.CreateFilter(
+                        {**create_req, "exist_ok": True, "restore": True}
+                    )
+                    restored_from_manifest += 1
+                except Exception:
+                    log.exception(
+                        "op-log manifest: re-creating filter %r failed", name
+                    )
+                    failed += 1
+            for rec in self.oplog.read_from(0):
+                try:
+                    if self.apply_record(rec):
+                        applied += 1
+                    else:
+                        skipped += 1
+                except Exception:
+                    log.exception(
+                        "op-log replay: record seq %d (%s) failed",
+                        rec.get("seq"), rec.get("method"),
+                    )
+                    failed += 1
+        finally:
+            self._replaying = False
+        self.metrics.count("repl_replay_applied", applied)
+        return {
+            "applied": applied,
+            "skipped": skipped,
+            "failed": failed,
+            "restored_from_manifest": restored_from_manifest,
+        }
+
+    def snapshot_plan(self):
+        """Full-resync payload: ``(names, iterator, plan_seq)`` from ONE
+        registry snapshot — the iterator lazily yields ``(name, blob,
+        applied_seq)`` per filter, each snapshot taken under its op lock
+        so the blob and its seq stamp are consistent. Lazy on purpose: a
+        blob can be filter-sized, so only one is in flight at a time
+        (the stream sends it before the next is built).
+
+        ``plan_seq`` is the log head read under the registry lock —
+        creates commit (log + publish) under that same lock, so every
+        record for a filter OUTSIDE ``names`` has ``seq > plan_seq``.
+        The resync tail cursor must be clamped to it: per-filter
+        ``applied_seq`` stamps taken later can run ahead of the plan and
+        would otherwise skip those creates."""
+        with self._lock:
+            items = list(self._filters.items())
+            plan_seq = self.oplog.last_seq if self.oplog is not None else 0
+
+        def gen():
+            for name, mf in items:
+                with mf.lock:
+                    _, _, blob = ckpt.snapshot_blob(mf.filter)
+                    applied_seq = mf.applied_seq
+                yield name, blob, applied_seq
+
+        return [name for name, _ in items], gen(), plan_seq
+
+    def install_snapshot(self, name: str, blob: bytes, applied_seq: int) -> None:
+        """Replica bootstrap: adopt a primary's filter snapshot wholesale
+        (config comes from the blob header — the primary's config IS the
+        truth), replacing any local filter of that name."""
+        filt = ckpt.restore_blob(blob)
+        config = (
+            filt.base_config if hasattr(filt, "layers") else filt.config
+        )
+        sink = self._sink_factory(config)
+        mf = _Managed(filt, sink, getattr(config, "checkpoint_every", 0))
+        mf.applied_seq = applied_seq
+        with self._lock:
+            old = self._filters.pop(name, None)
+            self._filters[name] = mf
+        if old is not None and old.checkpointer:
+            old.checkpointer.close(final_checkpoint=False)
+        self.metrics.count("repl_snapshots_installed")
+
+    def retain_only(self, names) -> None:
+        """Post-full-resync: a resync is a state reset, so filters the
+        primary no longer has must go (their checkpoints stay in the
+        local sink untouched)."""
+        keep = set(names)
+        with self._lock:
+            victims = [
+                (n, mf) for n, mf in self._filters.items() if n not in keep
+            ]
+            for n, _ in victims:
+                del self._filters[n]
+        for n, mf in victims:
+            if mf.checkpointer:
+                mf.checkpointer.close(final_checkpoint=False)
 
     # -- RPC handlers (dict in, dict out) ------------------------------------
 
@@ -208,6 +540,13 @@ class BloomService:
                     reasons.append(f"checkpoint_corrupt:{name}")
         if time.time() - self._last_shed_time < SHED_DEGRADED_WINDOW_S:
             reasons.append("shedding")
+        ra = self.replica_applier
+        if ra is not None and ra.link not in ("connected", "syncing"):
+            # a replica serving reads off a dead link is serving stale
+            # data — say so, machine-readably
+            reasons.append(f"replication_link:{ra.link}")
+        if self.oplog_error is not None:
+            reasons.append("oplog_append_error")
         return reasons
 
     def Health(self, req: dict) -> dict:
@@ -222,7 +561,7 @@ class BloomService:
             status = "SERVING"
         with self._admit_lock:
             in_flight = self._in_flight
-        return {
+        resp = {
             "ok": True,
             "status": status,
             "reasons": reasons,
@@ -231,7 +570,16 @@ class BloomService:
             "filters": len(self._filters),
             "in_flight": in_flight,
             "max_in_flight": self.max_in_flight,
+            "role": "replica" if self.read_only else "primary",
         }
+        if self.replica_applier is not None:
+            resp["replication"] = self.replica_applier.status()
+        elif self.oplog is not None:
+            resp["replication"] = {
+                "log": self.oplog.stats(),
+                "replicas": self.repl_sessions.describe(),
+            }
+        return resp
 
     @staticmethod
     def _parse_config(req: dict, name: str) -> FilterConfig:
@@ -404,9 +752,15 @@ class BloomService:
                 filt = BlockedBloomFilter(config)
             else:
                 filt = BloomFilter(config)
-            self._filters[name] = _Managed(
-                filt, sink, config.checkpoint_every
+            mf = _Managed(filt, sink, config.checkpoint_every)
+            mf.applied_seq = int(
+                getattr(filt, "_restored_meta", {}).get("repl_seq", 0) or 0
             )
+            # log BEFORE publishing: _get reads _filters lock-free, so a
+            # concurrent insert on the new filter must not be able to log
+            # a seq below the create record's
+            self._log_create(req, mf, restored)
+            self._filters[name] = mf
             self.metrics.count("filters_created")
             return {
                 "ok": True,
@@ -414,6 +768,77 @@ class BloomService:
                 "restored_seq": getattr(filt, "_restored_seq", None),
                 "config": config.to_dict(),
             }
+
+    def _log_create(self, req: dict, mf: _Managed, restored) -> None:
+        """Op-log a landed CreateFilter (+ the creation manifest). A
+        create that bootstrapped state from a checkpoint is stamped
+        ``restored_seq`` — replicas cannot reproduce those bytes from
+        records, so applying such a record triggers a full resync (the
+        snapshot carries the state)."""
+        logged = {k: v for k, v in req.items() if k != "rid"}
+        if restored is not None:
+            logged["restored_seq"] = getattr(restored, "_restored_seq", None)
+        self._log_op("CreateFilter", logged, mf, may_truncate=False)
+        self._manifest_put(req["name"], {k: v for k, v in logged.items()
+                                         if k != "restored_seq"})
+
+    # -- creation manifest ---------------------------------------------------
+    #
+    # Checkpoint-keyed truncation may drop a live filter's CreateFilter
+    # record while newer records for it remain in the log (the create is
+    # covered by a landed checkpoint; the tail is not). Replay would then
+    # skip those records as "unknown filter" — losing acked writes. The
+    # manifest is the durable live-filter set next to the log: replay
+    # re-creates (restore=True, pulling the covering checkpoint) from it
+    # FIRST, then drives the record tail over that.
+
+    def _manifest_path(self) -> Optional[str]:
+        if self.oplog is None:
+            return None
+        import os
+
+        return os.path.join(self.oplog.directory, "manifest.json")
+
+    def _manifest_put(self, name: str, create_req: dict) -> None:
+        self._manifest_write(lambda m: m.__setitem__(name, create_req))
+
+    def _manifest_remove(self, name: str) -> None:
+        self._manifest_write(lambda m: m.pop(name, None))
+
+    def _manifest_write(self, mutate) -> None:
+        """Read-mutate-write the manifest atomically (callers hold
+        ``self._lock``, which serializes create/drop commit points)."""
+        path = self._manifest_path()
+        if path is None or self._replaying:
+            return
+        import json
+        import os
+
+        try:
+            manifest = self._manifest_read() or {}
+            mutate(manifest)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, path)
+        except Exception:
+            log.exception("op-log creation manifest write failed")
+
+    def _manifest_read(self) -> Optional[dict]:
+        path = self._manifest_path()
+        if path is None:
+            return None
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            log.exception("op-log creation manifest unreadable; ignoring")
+            return None
 
     def _create_scalable(self, req: dict, name: str) -> dict:
         """Scalable-filter CreateFilter branch (caller holds self._lock).
@@ -444,7 +869,12 @@ class BloomService:
                 growth=policy["growth"],
                 tightening=policy["tightening"],
             )
-        self._filters[name] = _Managed(filt, sink, base.checkpoint_every)
+        mf = _Managed(filt, sink, base.checkpoint_every)
+        mf.applied_seq = int(
+            getattr(filt, "_restored_meta", {}).get("repl_seq", 0) or 0
+        )
+        self._log_create(req, mf, restored)  # before publish — see CreateFilter
+        self._filters[name] = mf
         self.metrics.count("filters_created")
         return {
             "ok": True,
@@ -457,6 +887,15 @@ class BloomService:
     def DropFilter(self, req: dict) -> dict:
         with self._lock:
             mf = self._filters.pop(req["name"], None)
+            if mf is not None:
+                # inside the lock: a concurrent CreateFilter of the same
+                # name must not log its create before this drop
+                self._log_op(
+                    "DropFilter",
+                    {k: v for k, v in req.items() if k != "rid"},
+                    may_truncate=False,
+                )
+                self._manifest_remove(req["name"])
         if mf is None:
             return {"ok": True, "existed": False}
         if mf.checkpointer:
@@ -477,9 +916,30 @@ class BloomService:
         with self._lock:
             return {"ok": True, "filters": sorted(self._filters)}
 
+    @staticmethod
+    def _insert_replay_unsafe(mf: _Managed, want_presence: bool) -> bool:
+        """True when a REPLAYED insert that already landed would corrupt
+        the answer: counting filters scatter-ADD (double-increment),
+        scalable filters double-count layer fill, and a presence replay
+        reports the batch's own keys as pre-existing. These answer
+        retries from the rid cache instead (ISSUE 3 satellite — the same
+        machinery that makes DeleteBatch retryable)."""
+        return bool(
+            want_presence
+            or getattr(mf.filter.config, "counting", False)
+            or hasattr(mf.filter, "layers")
+        )
+
     def InsertBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
         want_presence = bool(req.get("return_presence"))
+        replay_unsafe = self._insert_replay_unsafe(mf, want_presence)
+        rid = req.get("rid")
+        if replay_unsafe:
+            cached = self._dedup_get(rid)
+            if cached is not None:
+                self.metrics.count("insert_dedup_hits")
+                return cached
         with mf.lock, tracing.request_span(
             "InsertBatch", batch=len(req["keys"]), rid=obs.current_rid()
         ):
@@ -496,12 +956,21 @@ class BloomService:
                     mf.filter.insert_batch(req["keys"])
             else:
                 mf.filter.insert_batch(req["keys"])
+            # log BEFORE notify_inserts: notify may trigger a checkpoint
+            # whose snapshot contains this batch — its repl_seq stamp
+            # (sampled from applied_seq at trigger time) must therefore
+            # already include this op, or a crash-replay re-applies it
+            self._log_op(
+                "InsertBatch", {"name": req["name"], "keys": req["keys"]}, mf
+            )
             if mf.checkpointer:
                 mf.checkpointer.notify_inserts(len(req["keys"]))
         self.metrics.count("keys_inserted", len(req["keys"]))
         resp = {"ok": True, "n": len(req["keys"])}
         if presence is not None:
             resp["presence"] = np.packbits(np.asarray(presence)).tobytes()
+        if replay_unsafe:
+            self._dedup_put(rid, resp)
         return resp
 
     def QueryBatch(self, req: dict) -> dict:
@@ -559,6 +1028,9 @@ class BloomService:
             return cached
         with mf.lock:
             mf.filter.delete_batch(req["keys"])
+            self._log_op(
+                "DeleteBatch", {"name": req["name"], "keys": req["keys"]}, mf
+            )
         self.metrics.count("keys_deleted", len(req["keys"]))
         resp = {"ok": True, "n": len(req["keys"])}
         self._dedup_put(rid, resp)
@@ -568,6 +1040,7 @@ class BloomService:
         mf = self._get(req["name"])
         with mf.lock:
             mf.filter.clear()
+            self._log_op("Clear", {"name": req["name"]}, mf)
         return {"ok": True}
 
     def Stats(self, req: dict) -> dict:
@@ -676,10 +1149,38 @@ def _wrap(service: BloomService, method_name: str):
     def unary_unary(request: bytes, context) -> bytes:
         t0 = time.perf_counter()
         with obs.request(method_name) as rctx:
-            # admission first, before decode: a shed must stay cheap when
-            # the server is drowning (that is the whole point of the cap)
-            shed = service.admit(method_name)
-            if shed is not None:
+            req_name = None
+            # readonly + admission first, before decode: a rejection must
+            # stay cheap when the server is drowning
+            if service.read_only and method_name in protocol.MUTATING_METHODS:
+                resp = protocol.error_response(
+                    "READONLY",
+                    f"{method_name} rejected: this server is a read-only "
+                    f"replica — send writes to the primary",
+                    details=(
+                        {"primary": service.primary_address}
+                        if service.primary_address
+                        else None
+                    ),
+                )
+                rctx.summary = "(readonly)"
+                service.metrics.count("readonly_rejected")
+            elif (
+                service.oplog_error is not None
+                and method_name in protocol.MUTATING_METHODS
+            ):
+                # fail-stop after an op-log append error: memory is ahead
+                # of the log; accepting more writes would widen the
+                # divergence silently (Redis MISCONF parity)
+                resp = protocol.error_response(
+                    "LOG_WRITE_FAILED",
+                    f"{method_name} rejected: op log append failed "
+                    f"({service.oplog_error}); writes are stopped until "
+                    f"the log is writable and the server restarts",
+                )
+                rctx.summary = "(log-failstop)"
+                service.metrics.count("log_failstop_rejected")
+            elif (shed := service.admit(method_name)) is not None:
                 resp = shed
                 rctx.summary = "(shed)"
             else:
@@ -694,6 +1195,8 @@ def _wrap(service: BloomService, method_name: str):
                     keys = req.get("keys")
                     rctx.batch = len(keys) if isinstance(keys, list) else 0
                     rctx.summary = summarize_request(method_name, req)
+                    name = req.get("name")
+                    req_name = name if isinstance(name, str) else None
                     resp = handler(req)
                     # post-apply fault: the handler's effect landed but the
                     # response is "lost" — the case rid-dedup must absorb
@@ -728,9 +1231,50 @@ def _wrap(service: BloomService, method_name: str):
                 args=rctx.summary,
                 phases=rctx.phases,
             )
+            if service.monitor_hub.active:
+                # MONITOR parity: one structured event per finished
+                # request (key payloads stay redacted to the summary)
+                service.monitor_hub.publish(
+                    {
+                        "kind": "op",
+                        "ts": time.time(),
+                        "method": method_name,
+                        "name": req_name,
+                        "rid": rctx.rid,
+                        "batch": rctx.batch,
+                        "args": rctx.summary,
+                        "duration_s": duration_s,
+                        "ok": bool(resp.get("ok", False)),
+                    }
+                )
         return raw
 
     return grpc.unary_unary_rpc_method_handler(unary_unary)
+
+
+#: Streaming RPC name -> generator(service, req, context) (ISSUE 3).
+_STREAM_BEHAVIORS = {
+    "ReplStream": repl_primary.repl_stream,
+    "Monitor": repl_monitor.monitor_stream,
+}
+
+
+def _wrap_stream(service: BloomService, method_name: str):
+    gen_fn = _STREAM_BEHAVIORS[method_name]
+
+    def unary_stream(request: bytes, context):
+        try:
+            req = protocol.decode(request) if request else {}
+        except Exception:
+            req = {}
+        service.metrics.count(f"stream_{method_name}_opened")
+        # an injected repl.stream_send fault (or any bug) propagates out
+        # of the generator: grpc surfaces a stream error and the replica
+        # reconnects — exactly the mid-batch-kill chaos case
+        for msg in gen_fn(service, req, context):
+            yield protocol.encode(msg)
+
+    return grpc.unary_stream_rpc_method_handler(unary_stream)
 
 
 def build_server(
@@ -744,6 +1288,9 @@ def build_server(
     ephemeral port.
     """
     handlers = {m: _wrap(service, m) for m in protocol.METHODS}
+    handlers.update(
+        {m: _wrap_stream(service, m) for m in protocol.STREAM_METHODS}
+    )
     generic = grpc.method_handlers_generic_handler(protocol.SERVICE, handlers)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -757,12 +1304,59 @@ def build_server(
     return server, port
 
 
+def _inspect_quarantine_main(argv: list) -> int:
+    """``python -m tpubloom.server inspect-quarantine <ckpt_dir>
+    [--purge] [--json]`` — operator view of the corrupt-checkpoint
+    quarantine (ISSUE 3 satellite)."""
+    import argparse
+    import json as _json
+
+    parser = argparse.ArgumentParser(
+        prog="tpubloom.server inspect-quarantine",
+        description="list / purge quarantined corrupt checkpoint blobs",
+    )
+    parser.add_argument("directory", help="the checkpoint directory")
+    parser.add_argument(
+        "--purge", action="store_true", help="delete every quarantined blob"
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    args = parser.parse_args(argv)
+    report = ckpt.inspect_quarantine(args.directory, purge=args.purge)
+    if args.as_json:
+        print(_json.dumps(report))
+    else:
+        print(
+            f"quarantine {report['quarantine_dir']}: "
+            f"{len(report['entries'])} blob(s), {report['total_bytes']} bytes"
+        )
+        for e in report["entries"]:
+            print(
+                f"  {e['file']:40s} {e['bytes']:>12d}B  "
+                f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(e['mtime']))}"
+                f"  {e['diagnosis']}"
+            )
+        if args.purge:
+            print(f"purged {report['purged']} blob(s)")
+    return 0
+
+
 def main(argv: Optional[list] = None) -> None:
     """``python -m tpubloom.server [port] [checkpoint_dir]
     [--metrics-port N] [--slowlog-capacity N] [--max-in-flight N]
-    [--drain-grace S]``"""
+    [--drain-grace S] [--repl-log-dir DIR] [--replica-of HOST:PORT]``
+
+    Subcommand: ``python -m tpubloom.server inspect-quarantine <dir>``.
+    """
     import argparse
     import signal
+    import sys as _sys
+
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "inspect-quarantine":
+        raise SystemExit(_inspect_quarantine_main(argv[1:]))
 
     parser = argparse.ArgumentParser(
         prog="tpubloom.server", description="tpubloom gRPC server"
@@ -797,7 +1391,32 @@ def main(argv: Optional[list] = None) -> None:
         help="seconds to let in-flight RPCs finish on SIGTERM/SIGINT "
         "before final checkpoints (default 15)",
     )
+    parser.add_argument(
+        "--repl-log-dir",
+        default=None,
+        help="append every mutating RPC to a CRC32C-framed op log in this "
+        "directory (AOF parity: startup replays it over the restored "
+        "checkpoints) and serve the ReplStream RPC to replicas",
+    )
+    parser.add_argument(
+        "--repl-fsync",
+        action="store_true",
+        help="fsync the op log on every append (Redis appendfsync-always "
+        "parity; default: OS page cache)",
+    )
+    parser.add_argument(
+        "--replica-of",
+        default=None,
+        metavar="HOST:PORT",
+        help="run as a read-only replica of the given primary: stream and "
+        "apply its op log, serve reads, answer writes with READONLY",
+    )
     args = parser.parse_args(argv)
+    if args.replica_of and args.repl_log_dir:
+        parser.error(
+            "--replica-of and --repl-log-dir are mutually exclusive "
+            "(chained replication is not supported yet)"
+        )
     ckpt_dir = args.checkpoint_dir
     sink_factory = (
         (lambda config: ckpt.FileSink(ckpt_dir)) if ckpt_dir else (lambda config: None)
@@ -806,11 +1425,32 @@ def main(argv: Optional[list] = None) -> None:
     faults.load_env()
     for armed in faults.active():
         log.warning("fault injection armed: %s", armed)
+    oplog = None
+    if args.repl_log_dir:
+        from tpubloom.repl import OpLog
+
+        oplog = OpLog(args.repl_log_dir, fsync=args.repl_fsync)
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
         max_in_flight=args.max_in_flight,
+        oplog=oplog,
+        read_only=bool(args.replica_of),
     )
+    if oplog is not None:
+        stats = service.replay_oplog()
+        log.info(
+            "op log %s: replayed %d record(s) (%d already covered by "
+            "checkpoints, %d failed), next seq %d",
+            args.repl_log_dir, stats["applied"], stats["skipped"],
+            stats["failed"], oplog.last_seq + 1,
+        )
+    applier = None
+    if args.replica_of:
+        from tpubloom.repl import ReplicaApplier
+
+        applier = ReplicaApplier(service, args.replica_of).start()
+        log.info("replicating from %s (read-only)", args.replica_of)
     server, bound = build_server(service, f"0.0.0.0:{args.port}")
     server.start()
     log.info("tpubloom server listening on :%d (checkpoints: %s)", bound, ckpt_dir)
@@ -842,8 +1482,12 @@ def main(argv: Optional[list] = None) -> None:
     # a roll, not an outage.
     time.sleep(min(2.0, args.drain_grace / 3))
     server.stop(grace=args.drain_grace).wait()
+    if applier is not None:
+        applier.stop()
     log.info("drain: final checkpoints...")
     service.shutdown()
+    if oplog is not None:
+        oplog.close()
     if metrics_server is not None:
         metrics_server.close()
     log.info("drain complete; exiting")

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""HA smoke: 3-node promote-under-load on CPU — the failover surface's
+canary (ISSUE 4), wired into tier-1 (``tests/test_ha.py::test_ha_smoke``)
+and CI.
+
+What it drives:
+
+* a primary (op log) + two **chained** replicas (``--replica-of`` +
+  ``--repl-log-dir`` equivalents) + a 3-sentinel quorum;
+* a writer hammers counting-filter ``InsertBatch`` (each batch a fresh
+  key set, one rid per logical batch) while the primary is stopped
+  mid-load;
+* the sentinels agree SDOWN→ODOWN, promote the most-caught-up replica,
+  re-point the survivor; the topology-aware client refreshes off the
+  sentinels and completes every batch;
+* **failover time-to-first-successful-write** is measured from the
+  primary's death to the first batch acked by the new primary;
+* the counting-filter proof: every acked batch re-driven with its
+  original rid is a dedup hit or a heal, all keys present exactly once
+  (one delete round empties them) — zero lost, zero doubled.
+
+Run directly (``python benchmarks/ha_smoke.py`` — prints one JSON line)
+or via tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def run_smoke() -> dict:
+    """Drive the 3-node failover scenario; returns summary facts
+    (raises on any failure)."""
+    from tpubloom import faults
+    from tpubloom.ha.sentinel import Sentinel
+    from tpubloom.repl import OpLog, ReplicaApplier
+    from tpubloom.server.client import BloomClient
+    from tpubloom.server.service import BloomService, build_server
+
+    faults.reset()
+    out: dict = {}
+    cleanup: list = []  # LIFO even on failure — leaked grpc servers hang exit
+
+    def make_primary():
+        oplog = OpLog(tempfile.mkdtemp(prefix="tpubloom-ha-smoke-p-"))
+        svc = BloomService(oplog=oplog)
+        srv, port = build_server(svc, "127.0.0.1:0")
+        srv.start()
+        svc.listen_address = f"127.0.0.1:{port}"
+        cleanup.append(lambda: srv.stop(grace=None))
+        cleanup.append(oplog.close)
+        return svc, srv, port, oplog
+
+    def make_chained_replica(pport):
+        oplog = OpLog(tempfile.mkdtemp(prefix="tpubloom-ha-smoke-r-"))
+        svc = BloomService(oplog=oplog, read_only=True)
+        srv, port = build_server(svc, "127.0.0.1:0")
+        srv.start()
+        svc.listen_address = f"127.0.0.1:{port}"
+        app = ReplicaApplier(
+            svc,
+            f"127.0.0.1:{pport}",
+            reconnect_base=0.05,
+            listen_address=svc.listen_address,
+        ).start()
+        cleanup.append(lambda: srv.stop(grace=None))
+        cleanup.append(oplog.close)
+        cleanup.append(
+            lambda: (svc.replica_applier or app).stop()
+        )
+        return svc, srv, port, app
+
+    try:
+        psvc, psrv, pport, poplog = make_primary()
+        boot = BloomClient(f"127.0.0.1:{pport}")
+        cleanup.append(boot.close)
+        boot.wait_ready()
+        boot.create_filter(
+            "smoke", capacity=50_000, error_rate=0.01, counting=True
+        )
+        replicas = [make_chained_replica(pport) for _ in range(2)]
+        for svc, _, _, app in replicas:
+            assert app.wait_for_seq(poplog.last_seq, 30), app.status()
+
+        sents = [
+            Sentinel(
+                f"127.0.0.1:{pport}",
+                peers=[],
+                poll_s=0.1,
+                down_after_s=0.5,
+                failover_cooldown_s=0.5,
+            )
+            for _ in range(3)
+        ]
+        for s in sents:
+            s.peers.extend(x.address for x in sents if x is not s)
+            s.quorum = 2
+        for s in sents:
+            s.start()
+            cleanup.append(s.stop)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(sents[0].handle_Topology({})["replicas"]) == 2:
+                break
+            time.sleep(0.05)
+        assert len(sents[0].handle_Topology({})["replicas"]) == 2
+
+        client = BloomClient(
+            sentinels=[s.address for s in sents],
+            max_retries=8,
+            backoff_base=0.1,
+            backoff_max=1.0,
+            breaker_threshold=0,
+        )
+        cleanup.append(client.close)
+
+        n_batches, batch_size = 24, 25
+        batches = [
+            [b"smoke-%03d-%03d" % (i, j) for j in range(batch_size)]
+            for i in range(n_batches)
+        ]
+        acked: list = []
+        kill_at = 6
+        killed = threading.Event()
+        kill_time = [0.0]
+        first_post_kill_ack = [0.0]
+
+        def writer():
+            for i, keys in enumerate(batches):
+                if i == kill_at:
+                    killed.set()
+                try:
+                    client.insert_batch("smoke", keys)
+                except Exception:  # noqa: BLE001 — re-drive, SAME rid
+                    rid = client.last_rid
+                    while True:
+                        try:
+                            client.refresh_topology()
+                            client._call_once(
+                                "InsertBatch",
+                                {"name": "smoke", "keys": keys, "rid": rid},
+                            )
+                            break
+                        except Exception:  # noqa: BLE001
+                            time.sleep(0.2)
+                acked.append((i, client.last_rid))
+                if kill_time[0] and not first_post_kill_ack[0]:
+                    first_post_kill_ack[0] = time.monotonic()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert killed.wait(60), "writer never reached the kill point"
+        kill_time[0] = time.monotonic()
+        psrv.stop(grace=None)  # the primary "crashes"
+        poplog.close()
+        t.join(timeout=120)
+        assert not t.is_alive(), "writer wedged during failover"
+        assert len(acked) == n_batches
+
+        out["failovers"] = sum(s.failovers for s in sents)
+        assert out["failovers"] >= 1, "no sentinel led a failover"
+        leader = next(s for s in sents if s.failovers)
+        topo = leader.handle_Topology({})
+        out["new_primary"] = topo["primary"]
+        out["epoch"] = topo["epoch"]
+        out["failover_seconds"] = round(
+            first_post_kill_ack[0] - kill_time[0], 3
+        )
+
+        # proof: re-drive every acked batch with its original rid, then
+        # count exactness with one delete round
+        redrive = BloomClient(topo["primary"])
+        cleanup.append(redrive.close)
+        for i, rid in acked:
+            redrive._call_once(
+                "InsertBatch",
+                {"name": "smoke", "keys": batches[i], "rid": rid},
+            )
+        all_keys = [k for b in batches for k in b]
+        present = redrive.include_batch("smoke", all_keys)
+        out["lost_acked"] = int((~present).sum())
+        assert out["lost_acked"] == 0, f"{out['lost_acked']} acked keys lost"
+        for i, _ in acked:
+            redrive.delete_batch("smoke", batches[i])
+        leftovers = redrive.include_batch("smoke", all_keys)
+        out["double_applied"] = int(leftovers.sum())
+        assert out["double_applied"] == 0, (
+            f"{out['double_applied']} keys double-applied"
+        )
+
+        # the surviving replica follows the new primary
+        survivor = next(
+            r for r in replicas if r[0].listen_address != topo["primary"]
+        )
+        new_app = survivor[0].replica_applier
+        assert new_app is not None
+        new_primary_svc = next(
+            r[0] for r in replicas if r[0].listen_address == topo["primary"]
+        )
+        assert new_app.wait_for_seq(new_primary_svc.oplog.last_seq, 30), (
+            new_app.status()
+        )
+        out["survivor_partial_syncs"] = new_app.partial_syncs
+    finally:
+        for fn in reversed(cleanup):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
+    return out
+
+
+def main() -> int:
+    if os.environ.get("JAX_PLATFORMS") is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    result = run_smoke()
+    print(json.dumps({"ok": True, **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,9 +58,20 @@ class Redis
         # of double-decrementing. Counting/presence INSERTS remain
         # non-retried on transport errors (scatter-ADDs; no dedup there).
 
+        SENTINEL_SERVICE = "tpubloom.Sentinel".freeze
+
         # opts mirrors the reference constructor options plus:
         #   :address       - "host:port" of the tpubloom server (default
         #                    127.0.0.1:50051)
+        #   :sentinels     - ["host:port", ...] of tpubloom sentinels: the
+        #                    driver resolves the current primary (and the
+        #                    topology epoch) from them at startup and
+        #                    REFRESHES on READONLY / STALE_EPOCH /
+        #                    exhausted-UNAVAILABLE — writes fail over to
+        #                    the newly promoted primary; the per-call rid
+        #                    makes a re-driven acknowledged batch answer
+        #                    from the server's dedup cache instead of
+        #                    double-applying
         #   :size          - expected capacity (n)
         #   :error_rate    - desired false-positive probability
         #   :key_name      - filter name (also the Redis checkpoint key)
@@ -75,8 +86,14 @@ class Redis
           @opts = opts
           @name = opts[:key_name] || "tpubloom"
           @max_retries = opts[:max_retries] || 5
+          @sentinels = Array(opts[:sentinels])
+          @epoch = nil
           address = opts[:address] || "127.0.0.1:50051"
-          @stub = GRPC::ClientStub.new(address, :this_channel_is_insecure)
+          if !@sentinels.empty? && (topo = fetch_topology)
+            address = topo["primary"] || address
+            @epoch = topo["epoch"]
+          end
+          connect(address)
           create_filter
         end
 
@@ -134,6 +151,47 @@ class Redis
 
         private
 
+        def connect(address)
+          @address = address
+          @stub = GRPC::ClientStub.new(address, :this_channel_is_insecure)
+        end
+
+        # Ask each sentinel for the current cluster view; first answer
+        # wins (SENTINEL get-master-addr-by-name parity).
+        def fetch_topology
+          @sentinels.each do |addr|
+            stub = GRPC::ClientStub.new(addr, :this_channel_is_insecure)
+            begin
+              raw = stub.request_response(
+                "/#{SENTINEL_SERVICE}/Topology",
+                {}.to_msgpack,
+                IDENTITY,
+                IDENTITY
+              )
+              resp = MessagePack.unpack(raw)
+              return resp if resp["ok"] && resp["primary"]
+            rescue GRPC::BadStatus
+              next
+            end
+          end
+          nil
+        end
+
+        # Adopt the sentinels' view iff its epoch is not older than the
+        # cached one; true iff the primary changed (retry should target
+        # the new process).
+        def refresh_topology
+          return false if @sentinels.empty?
+          topo = fetch_topology
+          return false unless topo
+          epoch = topo["epoch"] || 0
+          return false if @epoch && epoch < @epoch
+          @epoch = epoch
+          changed = topo["primary"] && topo["primary"] != @address
+          connect(topo["primary"]) if changed
+          changed
+        end
+
         def create_filter
           req = { "name" => @name, "exist_ok" => true }
           if @opts[:config]
@@ -153,6 +211,9 @@ class Redis
              (@opts[:config] || {})[:counting])
         end
 
+        MUTATING = %w[CreateFilter DropFilter InsertBatch DeleteBatch
+                      Clear].freeze
+
         def rpc(method, payload, no_retry: false)
           no_retry ||= method == "InsertBatch" && counting?
           retries = no_retry ? 0 : @max_retries
@@ -162,9 +223,23 @@ class Redis
           attempt = 0
           shed_attempt = 0
           recreated = false
+          redirected = false
+          failed_over = false
+          stale_refreshed = false
           begin
+            # stamp the cached topology epoch on writes: a server under a
+            # newer topology answers STALE_EPOCH and we refresh
+            payload["epoch"] = @epoch if @epoch && MUTATING.include?(method)
             rpc_once(method, payload)
           rescue GRPC::Unavailable
+            # mid-failover the old primary is unreachable: re-resolve the
+            # topology; a changed primary resets the budget once (the rid
+            # makes a re-driven landed batch a dedup hit, never a double)
+            if !failed_over && refresh_topology
+              failed_over = true
+              attempt = 0
+              retry
+            end
             raise if attempt >= retries
             sleep([0.2 * (2**attempt), 5.0].min * (0.5 + rand))
             attempt += 1
@@ -180,6 +255,28 @@ class Redis
               sleep(delay * (0.75 + rand / 2))
               shed_attempt += 1
               retry
+            end
+            if e.code == "STALE_EPOCH" && !stale_refreshed
+              # our cached topology predates a failover: adopt + retry
+              stale_refreshed = true
+              @epoch = [@epoch || 0, e.details["epoch"] || 0].max
+              refresh_topology
+              retry
+            end
+            if e.code == "READONLY" && !redirected
+              # the node we wrote to is a replica: follow the sentinels'
+              # view (it wins — mid-failover the hint may be stale), or
+              # the primary address its error advertises, MOVED-style
+              redirected = true
+              if refresh_topology
+                retry
+              end
+              primary = e.details["primary"]
+              if primary && primary != @address
+                connect(primary)
+                retry
+              end
+              raise
             end
             # A restarted server has not seen the filter yet: re-create it
             # (restores the newest checkpoint), then retry the op once.

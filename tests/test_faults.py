@@ -757,3 +757,120 @@ def test_faults_smoke():
     assert result["restored_past_corruption"]
     assert result["sheds"] > 0
     assert result["deletes_double_applied"] == 0
+
+
+# -- per-shard fault points (ISSUE 4 satellite) ------------------------------
+
+
+def _routes_of(cfg, keys):
+    """Host-side shard routing of each key (mirrors the device hash)."""
+    import jax.numpy as jnp
+
+    from tpubloom.ops import hashing
+    from tpubloom.utils.packing import pack_keys
+
+    keys_u8, lengths = pack_keys(keys, cfg.key_len)
+    return np.asarray(
+        hashing.route_shards(
+            jnp.asarray(keys_u8),
+            jnp.asarray(np.maximum(lengths, 0)),
+            n_shards=cfg.shards,
+            seed=cfg.seed,
+        )
+    )
+
+
+def test_shard_fault_point_predicate_partial_failure():
+    """The ISSUE-4 chaos contract: ``shard.insert`` with a ``shard=N``
+    predicate fails ONLY batches that route a key to shard N — every
+    other shard keeps serving (partial failure, not an outage)."""
+    from tpubloom.parallel.sharded import ShardedBloomFilter
+
+    cfg = FilterConfig(m=1 << 20, k=4, key_len=16, shards=8)
+    f = ShardedBloomFilter(cfg)
+    rng = np.random.default_rng(11)
+    keys = _rand_keys(256, rng)
+    routes = _routes_of(cfg, keys)
+    target = int(routes[0])
+    hit = [k for k, r in zip(keys, routes) if r == target]
+    miss = [k for k, r in zip(keys, routes) if r != target][:32]
+    assert hit and miss, "batch did not spread over shards"
+
+    faults.arm("shard.insert", "always", pred={"shard": target})
+    # a batch touching the target shard dies...
+    with pytest.raises(faults.InjectedFault):
+        f.insert_batch(hit[:4])
+    # ...but batches routed AROUND it land fine (partial failure)
+    f.insert_batch(miss)
+    assert np.asarray(f.include_batch(miss)).all()
+    faults.disarm("shard.insert")
+
+    # the query path has its own point; `once` disarms after one firing
+    faults.arm("shard.query", "once", pred={"shard": target})
+    assert np.asarray(f.include_batch(miss)).all()  # doesn't touch target
+    with pytest.raises(faults.InjectedFault):
+        f.include_batch(hit[:2])
+    f.include_batch(hit[:2])  # budget spent: the shard serves again
+
+
+def test_shard_fault_partial_failure_chaos_sharded_server(tmp_path):
+    """Partial-failure chaos end to end: a sharded filter behind the
+    server keeps answering for healthy shards while one shard's insert
+    path is poisoned; the client sees a structured INTERNAL error for
+    poisoned batches, not a dead server — and the shard heals when the
+    fault disarms."""
+    service = BloomService()
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=0)
+    cfg = FilterConfig(m=1 << 20, k=4, key_len=16, shards=8)
+    try:
+        client.wait_ready()
+        client.create_filter(
+            "sh", config={"m": 1 << 20, "k": 4, "key_len": 16, "shards": 8}
+        )
+        rng = np.random.default_rng(12)
+        keys = _rand_keys(256, rng)
+        routes = _routes_of(cfg, keys)
+        target = int(routes[0])
+        poisoned = [k for k, r in zip(keys, routes) if r == target][:8]
+        healthy = [k for k, r in zip(keys, routes) if r != target][:64]
+
+        faults.arm("shard.insert", "always", pred={"shard": target})
+        with pytest.raises(BloomServiceError, match="INTERNAL"):
+            client.insert_batch("sh", poisoned)
+        client.insert_batch("sh", healthy)  # unaffected shards serve
+        assert client.include_batch("sh", healthy).all()
+        assert not client.include_batch("sh", poisoned).any()
+        assert obs_counters.get("fault_shard_insert") >= 1
+
+        faults.disarm("shard.insert")  # the shard heals
+        client.insert_batch("sh", poisoned)
+        assert client.include_batch("sh", poisoned).all()
+    finally:
+        client.close()
+        srv.stop(grace=None)
+
+
+def test_shard_fault_env_predicate_syntax(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "shard.insert=once:shard=3")
+    faults.load_env(force=True)
+    (desc,) = faults.active()
+    assert desc["point"] == "shard.insert"
+    assert desc["pred"] == {"shard": "3"}
+    # non-matching context passes through WITHOUT consuming the budget
+    assert faults.fire("shard.insert", shard=1) is None
+    assert faults.fire("shard.insert") is None
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("shard.insert", shard=3)
+    assert faults.fire("shard.insert", shard=3) is None  # once spent
+
+
+def test_dist_initialize_fault_point():
+    from tpubloom.parallel.distributed import initialize_multihost
+
+    faults.arm("dist.initialize", "once")
+    with pytest.raises(faults.InjectedFault):
+        initialize_multihost()
+    topo = initialize_multihost()  # disarmed: single-host no-op
+    assert topo["process_count"] >= 1

@@ -59,6 +59,10 @@ GOLDEN = {
     "DropFilter": ("DropFilter", "81a46e616d65aa676f6c64656e2d636e74"),
     "SlowlogGet": ("SlowlogGet", "81a16e0a"),
     "SlowlogReset": ("SlowlogReset", "80"),
+    # HA verbs (ISSUE 4): a bare Promote and REPLICAOF NO ONE are both
+    # idempotent no-ops on a primary — safe to replay raw
+    "Promote": ("Promote", "80"),
+    "ReplicaOf": ("ReplicaOf", "81a77072696d617279a64e4f204f4e45"),
 }
 
 #: the dict each fixture encodes (the pin below keeps python<->ruby
@@ -85,6 +89,8 @@ GOLDEN_DICTS = {
     "DropFilter": {"name": "golden-cnt"},
     "SlowlogGet": {"n": 10},
     "SlowlogReset": {},
+    "Promote": {},
+    "ReplicaOf": {"primary": "NO ONE"},
 }
 
 
@@ -174,6 +180,13 @@ def test_golden_replay_against_live_server(raw_server):
 
     # slowlog parity RPCs: every request above was recorded (no rid in
     # the raw golden bytes -> the server generated one per request)
+    # HA verbs: on a primary both are idempotent acknowledgements (the
+    # Ruby driver reads ok/epoch)
+    r = _call(ch, *GOLDEN["Promote"])
+    assert r["ok"] and r["already_primary"] and isinstance(r["epoch"], int)
+    r = _call(ch, *GOLDEN["ReplicaOf"])
+    assert r["ok"] and r["already_primary"]
+
     r = _call(ch, *GOLDEN["SlowlogGet"])
     assert r["ok"] and len(r["entries"]) > 0
     e = r["entries"][0]

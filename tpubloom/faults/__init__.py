@@ -21,6 +21,15 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
   each snapshot/record send (kills a replication stream mid-batch)
 * ``repl.apply``        — in the replica/replay apply path before a
   record's handler runs
+* ``repl.reappend``     — on a chained replica, before an applied record
+  re-appends to the local op log (ISSUE 4)
+* ``ha.promote``        — at the top of replica→primary promotion
+* ``ha.vote``           — in the sentinel vote-request/grant path
+* ``shard.insert`` / ``shard.query`` / ``shard.delete`` — per-shard
+  points in :class:`tpubloom.parallel.sharded.ShardedBloomFilter`:
+  fired once per shard the batch routes to, with ``shard=<index>``
+  context — arm with a ``shard=N`` predicate for partial failures
+* ``dist.initialize``   — in ``initialize_multihost`` before joining
 
 Trigger policies (``policy`` argument / env syntax):
 
@@ -37,11 +46,18 @@ work honor it (``ckpt.write`` truncates the blob mid-write, the torn-
 file case CRC validation must catch). A ``times=K`` cap bounds any
 policy to K total firings.
 
+**Predicates** (ISSUE 4): a point may fire with context
+(``fire("shard.insert", shard=3)``); an armed fault with a predicate
+(``arm(..., pred={"shard": 3})`` / env ``shard.insert=always:shard=3``)
+only triggers on passes whose context matches every predicate item —
+passes that don't match don't consume the policy budget.
+
 Arming: tests call :func:`arm` / :func:`disarm` / :func:`reset`
 directly; operators set ``TPUBLOOM_FAULTS`` before process start, e.g.::
 
     TPUBLOOM_FAULTS="ckpt.fsync=once,rpc.pre_handle=prob:0.01:seed=7"
     TPUBLOOM_FAULTS="ckpt.write=nth:3:mode=torn:times=2"
+    TPUBLOOM_FAULTS="shard.insert=once:shard=2"
 
 Every firing increments the process-global counters
 ``faults_injected`` and ``fault_<point>`` (dots become underscores), so
@@ -70,6 +86,13 @@ KNOWN_POINTS = {
     "repl.append",
     "repl.stream_send",
     "repl.apply",
+    "repl.reappend",
+    "ha.promote",
+    "ha.vote",
+    "shard.insert",
+    "shard.query",
+    "shard.delete",
+    "dist.initialize",
 }
 
 MODES = ("raise", "torn")
@@ -101,14 +124,22 @@ def register_point(name: str) -> None:
 class _Fault:
     """One armed fault: policy + mode + remaining-firings budget."""
 
-    __slots__ = ("point", "policy", "mode", "times", "_passes", "_nth", "_prob",
-                 "_rng", "fired")
+    __slots__ = ("point", "policy", "mode", "times", "pred", "_passes",
+                 "_nth", "_prob", "_rng", "fired")
 
-    def __init__(self, point: str, policy: str, mode: str, times: Optional[int]):
+    def __init__(
+        self,
+        point: str,
+        policy: str,
+        mode: str,
+        times: Optional[int],
+        pred: Optional[dict] = None,
+    ):
         self.point = point
         self.policy = policy
         self.mode = mode
         self.times = times
+        self.pred = pred or {}
         self._passes = 0
         self.fired = 0
         self._nth = 0
@@ -138,6 +169,14 @@ class _Fault:
                 "(want always | once | nth:N | prob:P[:seed=S])"
             )
 
+    def matches(self, ctx: dict) -> bool:
+        """True iff every predicate item equals the pass context (string
+        comparison, so ``shard=3`` from the env matches ``shard=3`` the
+        int). A pass that doesn't match doesn't consume the budget."""
+        return all(
+            str(ctx.get(key)) == str(want) for key, want in self.pred.items()
+        )
+
     def should_fire(self) -> bool:
         """One pass through the point; True iff the fault triggers now."""
         if self.times is not None and self.fired >= self.times:
@@ -159,6 +198,7 @@ class _Fault:
             "policy": self.policy,
             "mode": self.mode,
             "times": self.times,
+            "pred": dict(self.pred),
             "passes": self._passes,
             "fired": self.fired,
         }
@@ -170,15 +210,18 @@ def arm(
     *,
     mode: str = "raise",
     times: Optional[int] = None,
+    pred: Optional[dict] = None,
 ) -> None:
-    """Arm ``point`` with a trigger policy (replacing any previous arm)."""
+    """Arm ``point`` with a trigger policy (replacing any previous arm).
+    ``pred`` restricts firing to passes whose :func:`fire` context
+    matches every item (e.g. ``pred={"shard": 2}``)."""
     if point not in KNOWN_POINTS:
         raise ValueError(
             f"unknown fault point {point!r} (known: {sorted(KNOWN_POINTS)})"
         )
     if mode not in MODES:
         raise ValueError(f"unknown fault mode {mode!r} (want one of {MODES})")
-    fault = _Fault(point, policy, mode, times)
+    fault = _Fault(point, policy, mode, times, pred)
     with _lock:
         _armed[point] = fault
 
@@ -203,7 +246,16 @@ def active() -> list[dict]:
         return [f.describe() for f in _armed.values()]
 
 
-def fire(point: str) -> Optional[str]:
+def is_armed(point: str) -> bool:
+    """True iff a fault is currently armed at ``point`` — lets callers
+    skip expensive context computation (e.g. host-side shard routing)
+    on the normal, disarmed path."""
+    if not _env_loaded:
+        load_env()
+    return point in _armed
+
+
+def fire(point: str, **ctx) -> Optional[str]:
     """Production-code hook: pass through fault point ``point``.
 
     Disarmed (or armed-but-not-triggering): returns None, and the caller
@@ -211,7 +263,9 @@ def fire(point: str) -> Optional[str]:
     :class:`InjectedFault`. Triggering with a directive mode (``torn``):
     returns the mode string — the caller implements the directive (and
     callers that don't know the directive treat it as None, which keeps
-    directive faults safe to arm against any point).
+    directive faults safe to arm against any point). ``ctx`` carries
+    pass context matched against the armed fault's predicate
+    (``fire("shard.insert", shard=2)``).
     """
     if not _env_loaded:
         load_env()
@@ -219,7 +273,11 @@ def fire(point: str) -> Optional[str]:
     if fault is None:
         return None
     with _lock:
-        if _armed.get(point) is not fault or not fault.should_fire():
+        if (
+            _armed.get(point) is not fault
+            or not fault.matches(ctx)
+            or not fault.should_fire()
+        ):
             return None
     _counters.incr("faults_injected")
     _counters.incr("fault_" + point.replace(".", "_"))
@@ -234,8 +292,10 @@ def load_env(force: bool = False) -> None:
     so armed faults are logged before traffic arrives). ``force``
     re-parses even after a previous load/reset (tests).
 
-    Syntax: comma-separated ``point=policy[:mode=M][:times=K]`` items;
-    the policy may itself carry colons (``nth:3``, ``prob:0.1:seed=7``).
+    Syntax: comma-separated ``point=policy[:mode=M][:times=K][:key=V...]``
+    items; the policy may itself carry colons (``nth:3``,
+    ``prob:0.1:seed=7``); any other ``key=V`` part becomes a predicate
+    item (``shard.insert=once:shard=2``).
     """
     global _env_loaded
     with _lock:
@@ -251,12 +311,17 @@ def load_env(force: bool = False) -> None:
             continue
         point, _, rest = item.partition("=")
         mode, times, policy_parts = "raise", None, []
+        pred: dict = {}
         for part in rest.split(":"):
             if part.startswith("mode="):
                 mode = part[len("mode="):]
             elif part.startswith("times="):
                 times = int(part[len("times="):])
-            else:
+            elif part.startswith("seed=") or "=" not in part:
+                # seed= belongs to the prob policy; bare parts are policy
                 policy_parts.append(part)
+            else:
+                key, _, val = part.partition("=")
+                pred[key] = val
         arm(point.strip(), ":".join(policy_parts) or "always",
-            mode=mode, times=times)
+            mode=mode, times=times, pred=pred or None)

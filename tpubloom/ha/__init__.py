@@ -1,0 +1,33 @@
+"""High-availability subsystem (ISSUE 4).
+
+PR 3 gave tpubloom Redis's replication story (op log, ``ReplStream``,
+read replicas); this package makes it survivable end to end — a process
+crash no longer loses write availability:
+
+* :mod:`tpubloom.ha.promotion` — replica→primary promotion (op-log
+  adoption, identity aliasing for cheap survivor resync, persisted
+  topology epoch) and primary→replica demotion (``ReplicaOf``, Redis
+  ``REPLICAOF`` parity);
+* :mod:`tpubloom.ha.sentinel` — the failover coordinator: a quorum of
+  watcher processes that health-poll the primary, agree on
+  SDOWN→ODOWN via epoch-stamped votes (Raft term discipline, no full
+  Raft), promote the most-caught-up replica, re-point survivors, and
+  fence stale-epoch primaries;
+* :mod:`tpubloom.ha.topology` — the epoch store + the cluster-view
+  struct sentinels announce and topology-aware clients cache.
+
+Chained replicas (``--replica-of`` + ``--repl-log-dir`` together) make
+promotion of a mid-chain node cheap: the replica re-appends applied
+records to its own log in the upstream's seq space and serves
+``ReplStream`` downstream, so its log IS the adopted log.
+"""
+
+from tpubloom.ha.promotion import become_replica, promote_to_primary
+from tpubloom.ha.topology import EpochStore, Topology
+
+__all__ = [
+    "become_replica",
+    "promote_to_primary",
+    "EpochStore",
+    "Topology",
+]

@@ -1,0 +1,652 @@
+"""Sentinel-parity failover coordinator (ISSUE 4 tentpole).
+
+``python -m tpubloom.sentinel --watch host:port --peers ...`` runs one
+watcher of a quorum of N. Each sentinel is a tiny gRPC service
+(:data:`tpubloom.server.protocol.SENTINEL_SERVICE`) plus a monitor
+thread:
+
+* **health polling** — the watched primary's ``Health`` RPC every
+  ``poll_s``; misses accumulate into **SDOWN** (subjectively down) after
+  ``down_after_s``, Redis Sentinel's terminology and shape;
+* **SDOWN→ODOWN by vote** — a subjectively-down sentinel asks its peers
+  for an epoch-stamped vote (``VoteDown``). A peer grants iff it also
+  sees the primary down AND has not yet voted in that epoch — the Raft
+  term rule (vote once per term) without the rest of Raft: no log
+  replication, just a leader lease for one failover. Majority of the
+  quorum = **ODOWN** + leadership for that epoch;
+* **failover** — the leader reads each known replica's ``Health`` and
+  picks the most caught-up one (highest replication cursor =
+  lowest ``repl_lag_seq``), sends it ``Promote {epoch}``, re-points the
+  survivors with ``ReplicaOf {primary, epoch}``, and announces the new
+  topology to its peers (``AnnounceTopology``);
+* **fencing** — any node later observed claiming ``role=primary`` under
+  an epoch OLDER than the current topology's (the restarted pre-failover
+  primary) is demoted on sight with ``ReplicaOf`` — split-brain ends the
+  moment a sentinel can reach the stale node;
+* **discovery** — replicas are discovered from the primary's
+  ``Health.replication.replicas[].listen`` announcements (Redis
+  ``INFO replication`` parity); clients ask any sentinel ``Topology``
+  for the current epoch/primary/replicas (``SENTINEL
+  get-master-addr-by-name`` parity).
+
+Fault point ``ha.vote`` fires in both the vote-request and vote-grant
+paths, so the chaos suite can kill a failover mid-election.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from tpubloom import faults
+from tpubloom.ha.topology import Topology
+from tpubloom.obs import counters as _counters
+from tpubloom.server import protocol
+
+log = logging.getLogger("tpubloom.sentinel")
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+]
+
+
+class Sentinel:
+    """One failover watcher; run N of these (N odd) for a quorum."""
+
+    def __init__(
+        self,
+        watch: str,
+        peers: Optional[list] = None,
+        *,
+        listen: str = "127.0.0.1:0",
+        quorum: Optional[int] = None,
+        poll_s: float = 0.25,
+        down_after_s: float = 1.5,
+        rpc_timeout_s: float = 1.0,
+        promote_timeout_s: Optional[float] = None,
+        failover_cooldown_s: float = 2.0,
+        sentinel_id: Optional[str] = None,
+    ):
+        import secrets
+
+        self.peers = list(peers or ())
+        total = len(self.peers) + 1
+        #: votes (incl. our own) needed for ODOWN + failover leadership;
+        #: default = majority, so two concurrent elections cannot both win
+        self.quorum = quorum if quorum is not None else total // 2 + 1
+        self.poll_s = poll_s
+        self.down_after_s = down_after_s
+        self.rpc_timeout_s = rpc_timeout_s
+        #: Promote/ReplicaOf are heavyweight (log adoption, epoch
+        #: persist, applier teardown) and MUST NOT be declared failed on
+        #: a health-poll-grade deadline — a spuriously "failed" promote
+        #: that lands late is how dueling co-primaries happen
+        self.promote_timeout_s = (
+            promote_timeout_s
+            if promote_timeout_s is not None
+            else max(5.0, 5 * rpc_timeout_s)
+        )
+        self.failover_cooldown_s = failover_cooldown_s
+        self.sentinel_id = sentinel_id or secrets.token_hex(8)
+        self.topology = Topology(epoch=0, primary=watch, replicas=[])
+        self._lock = threading.Lock()
+        #: newest epoch this sentinel has VOTED in (self-votes included):
+        #: one vote per epoch is the whole split-brain argument
+        self._last_vote_epoch = 0
+        self._sdown = False
+        self._first_fail: Optional[float] = None
+        self._last_failover_attempt = 0.0
+        #: when we last GRANTED a peer's vote: someone else is leading a
+        #: failover — hold our own candidacy back so the quorum does not
+        #: burn epochs on dueling elections (Redis Sentinel's
+        #: failover-timeout hold-off, randomly staggered like its
+        #: election delays)
+        self._granted_at = 0.0
+        import random as _random
+
+        self._rand = _random.Random()
+        self._election_stagger = self._rand.uniform(0, failover_cooldown_s)
+        #: demoted-primary watchlist: addresses to fence if they come
+        #: back claiming a stale primaryship
+        self._fence_watch: set = set()
+        self.failovers = 0
+        self._stop = threading.Event()
+        self._channels: dict = {}
+        self._thread = threading.Thread(
+            target=self._run, name="tpubloom-sentinel", daemon=True
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="sentinel-rpc"
+            )
+        )
+        handlers = {
+            m: grpc.unary_unary_rpc_method_handler(self._wrap(m))
+            for m in protocol.SENTINEL_METHODS
+        }
+        self._server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    protocol.SENTINEL_SERVICE, handlers
+                ),
+            )
+        )
+        self.port = self._server.add_insecure_port(listen)
+        host = listen.rsplit(":", 1)[0] or "127.0.0.1"
+        self.address = f"{host}:{self.port}"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Sentinel":
+        self._server.start()
+        self._thread.start()
+        log.info(
+            "sentinel %s watching %s (quorum %d of %d, peers %s) on %s",
+            self.sentinel_id, self.topology.primary, self.quorum,
+            len(self.peers) + 1, self.peers, self.address,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._server.stop(grace=None)
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+    # -- RPC plumbing --------------------------------------------------------
+
+    def _wrap(self, method: str):
+        handler = getattr(self, "handle_" + method)
+
+        def unary_unary(request: bytes, context) -> bytes:
+            try:
+                req = protocol.decode(request) if request else {}
+                resp = handler(req)
+            except Exception as e:  # noqa: BLE001 — surface, don't kill
+                log.exception("sentinel RPC %s failed", method)
+                resp = protocol.error_response(
+                    "INTERNAL", f"{type(e).__name__}: {e}"
+                )
+            return protocol.encode(resp)
+
+        return unary_unary
+
+    def _channel(self, address: str):
+        ch = self._channels.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+            self._channels[address] = ch
+        return ch
+
+    def _call(
+        self,
+        address: str,
+        path: str,
+        req: dict,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        raw = self._channel(address).unary_unary(
+            path,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(protocol.encode(req), timeout=timeout or self.rpc_timeout_s)
+        return protocol.decode(raw)
+
+    def _node(
+        self,
+        address: str,
+        method: str,
+        req: dict,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        return self._call(
+            address, protocol.method_path(method), req, timeout=timeout
+        )
+
+    def _peer(self, address: str, method: str, req: dict) -> dict:
+        return self._call(address, protocol.sentinel_method_path(method), req)
+
+    # -- sentinel RPC handlers ------------------------------------------------
+
+    def handle_Ping(self, req: dict) -> dict:
+        return {
+            "ok": True,
+            "sentinel_id": self.sentinel_id,
+            "epoch": self.topology.epoch,
+            "sdown": self._sdown,
+        }
+
+    def handle_Topology(self, req: dict) -> dict:
+        """Client-facing discovery (SENTINEL get-master-addr parity)."""
+        with self._lock:
+            return {"ok": True, **self.topology.to_dict()}
+
+    def handle_VoteDown(self, req: dict) -> dict:
+        """Epoch-stamped leader vote: granted iff we ALSO see that
+        primary down (our own SDOWN — the ODOWN agreement) and we have
+        not voted in this epoch yet (the term discipline)."""
+        faults.fire("ha.vote")
+        epoch = int(req.get("epoch") or 0)
+        primary = req.get("primary")
+        with self._lock:
+            granted = (
+                primary == self.topology.primary
+                and self._sdown
+                and epoch > self.topology.epoch
+                and epoch > self._last_vote_epoch
+            )
+            if granted:
+                self._last_vote_epoch = epoch
+                self._granted_at = time.monotonic()
+                _counters.incr("sentinel_votes_granted")
+        return {
+            "ok": True,
+            "granted": granted,
+            "epoch": self.topology.epoch,
+            "sdown": self._sdown,
+        }
+
+    def handle_AnnounceTopology(self, req: dict) -> dict:
+        """A failover leader announcing its result; adopt if newer."""
+        incoming = Topology.from_dict(req)
+        with self._lock:
+            adopted = self.topology.adopt(incoming)
+            if adopted:
+                self._sdown = False
+                self._first_fail = None
+                old = req.get("fenced")
+                if old:
+                    self._fence_watch.add(old)
+                log.info(
+                    "adopted topology epoch %d (primary %s) from peer",
+                    incoming.epoch, incoming.primary,
+                )
+        return {"ok": True, "adopted": adopted, "epoch": self.topology.epoch}
+
+    # -- the monitor loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self._poll_primary()
+                self._fence_stale_primaries()
+                now = time.monotonic()
+                # a granted vote means a peer is leading a failover that
+                # may legitimately take up to promote_timeout — hold our
+                # own candidacy back at least that long
+                grant_holdoff = max(
+                    4 * self.failover_cooldown_s,
+                    self.failover_cooldown_s + self.promote_timeout_s,
+                )
+                if (
+                    self._sdown
+                    and now - self._last_failover_attempt
+                    >= self.failover_cooldown_s + self._election_stagger
+                    and now - self._granted_at >= grant_holdoff
+                ):
+                    self._last_failover_attempt = now
+                    # re-roll the stagger per attempt so two sentinels
+                    # whose retry slots collided once do not collide on
+                    # every retry
+                    self._election_stagger = self._rand.uniform(
+                        0, self.failover_cooldown_s
+                    )
+                    self._attempt_failover()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                log.exception("sentinel monitor tick failed")
+
+    def _poll_primary(self) -> None:
+        with self._lock:
+            primary = self.topology.primary
+        try:
+            h = self._node(primary, "Health", {})
+        except grpc.RpcError:
+            now = time.monotonic()
+            if self._first_fail is None:
+                self._first_fail = now
+            if not self._sdown and now - self._first_fail >= self.down_after_s:
+                self._sdown = True
+                # start the election clock HERE: every sentinel reaches
+                # SDOWN within one poll period of the others, so an
+                # immediately-eligible first attempt is a guaranteed
+                # three-way self-vote tie. The staggered delay gives one
+                # sentinel a clean head start instead (Redis Sentinel's
+                # randomized failover start delay).
+                self._last_failover_attempt = now
+                _counters.incr("sentinel_sdown_entered")
+                log.warning(
+                    "sentinel %s: %s is subjectively DOWN",
+                    self.sentinel_id, primary,
+                )
+            _counters.set_gauge("sentinel_sdown", 1.0 if self._sdown else 0.0)
+            return
+        self._first_fail = None
+        if self._sdown:
+            log.info("sentinel %s: %s is back", self.sentinel_id, primary)
+        self._sdown = False
+        _counters.set_gauge("sentinel_sdown", 0.0)
+        with self._lock:
+            self.topology.epoch = max(
+                self.topology.epoch, int(h.get("epoch") or 0)
+            )
+            if h.get("role") == "replica":
+                # the watched node was demoted behind our back (manual
+                # REPLICAOF / a failover we missed): follow its view
+                upstream = (h.get("replication") or {}).get("primary")
+                if upstream and upstream != primary:
+                    log.warning(
+                        "watched node %s is now a replica of %s; following",
+                        primary, upstream,
+                    )
+                    self._fence_watch.discard(upstream)
+                    if primary not in self.topology.replicas:
+                        self.topology.replicas.append(primary)
+                    self.topology.primary = upstream
+                return
+            # discover announced replicas (INFO replication parity)
+            sessions = (h.get("replication") or {}).get("replicas") or ()
+            listens = [s.get("listen") for s in sessions if s.get("listen")]
+            for addr in listens:
+                if addr not in self.topology.replicas:
+                    self.topology.replicas.append(addr)
+            _counters.set_gauge(
+                "sentinel_known_replicas", len(self.topology.replicas)
+            )
+
+    def _fence_stale_primaries(self) -> None:
+        """Demote any watched-for node that reappears claiming a stale
+        primaryship — the restarted pre-failover primary."""
+        with self._lock:
+            watch = list(self._fence_watch)
+            epoch, primary = self.topology.epoch, self.topology.primary
+        for addr in watch:
+            if addr == primary:
+                with self._lock:
+                    self._fence_watch.discard(addr)
+                continue
+            try:
+                h = self._node(addr, "Health", {})
+            except grpc.RpcError:
+                continue
+            if h.get("role") == "primary" and int(h.get("epoch") or 0) < epoch:
+                log.warning(
+                    "fencing stale primary %s (epoch %s < %d): demoting "
+                    "to replica of %s",
+                    addr, h.get("epoch"), epoch, primary,
+                )
+                try:
+                    self._node(
+                        addr,
+                        "ReplicaOf",
+                        {"primary": primary, "epoch": epoch},
+                        timeout=self.promote_timeout_s,
+                    )
+                    _counters.incr("sentinel_fenced")
+                except grpc.RpcError:
+                    continue
+            # demoted (by us or already a replica): back into the pool
+            with self._lock:
+                self._fence_watch.discard(addr)
+                if (
+                    addr != self.topology.primary
+                    and addr not in self.topology.replicas
+                ):
+                    self.topology.replicas.append(addr)
+
+    # -- failover ------------------------------------------------------------
+
+    def _adopt_completed_failover(self) -> bool:
+        """Before spending an epoch on an election, look for a failover
+        that ALREADY happened: a known replica claiming primaryship
+        under a newer epoch means some leader finished while this
+        sentinel was still counting misses (its AnnounceTopology may be
+        in flight, or lost). Adopting it is cheaper than dueling — and
+        dueling elections under load are exactly how a quorum burns
+        epochs re-promoting the same node."""
+        with self._lock:
+            candidates = list(self.topology.replicas)
+            epoch = self.topology.epoch
+            old_primary = self.topology.primary
+        for addr in candidates:
+            try:
+                h = self._node(addr, "Health", {})
+            except grpc.RpcError:
+                continue
+            if h.get("role") == "primary" and int(h.get("epoch") or 0) > epoch:
+                incoming = Topology(
+                    epoch=int(h["epoch"]),
+                    primary=addr,
+                    replicas=[a for a in candidates if a != addr],
+                )
+                with self._lock:
+                    if self.topology.adopt(incoming):
+                        self._sdown = False
+                        self._first_fail = None
+                        self._fence_watch.add(old_primary)
+                log.info(
+                    "adopted completed failover: %s is primary at epoch %d",
+                    addr, incoming.epoch,
+                )
+                _counters.incr("sentinel_failovers_adopted")
+                return True
+        return False
+
+    def _attempt_failover(self) -> None:
+        if self._adopt_completed_failover():
+            return
+        with self._lock:
+            new_epoch = max(self.topology.epoch, self._last_vote_epoch) + 1
+            primary = self.topology.primary
+            # vote for ourselves (term discipline: once per epoch)
+            self._last_vote_epoch = new_epoch
+        faults.fire("ha.vote")
+        votes = 1
+        for peer in self.peers:
+            try:
+                resp = self._peer(
+                    peer,
+                    "VoteDown",
+                    {"epoch": new_epoch, "primary": primary,
+                     "candidate": self.sentinel_id},
+                )
+            except grpc.RpcError:
+                continue
+            if resp.get("granted"):
+                votes += 1
+        _counters.set_gauge("sentinel_last_election_votes", votes)
+        if votes < self.quorum:
+            log.info(
+                "sentinel %s: election for epoch %d got %d/%d votes; "
+                "will retry",
+                self.sentinel_id, new_epoch, votes, self.quorum,
+            )
+            return
+        _counters.incr("sentinel_odown_agreed")
+        log.warning(
+            "sentinel %s: %s is objectively DOWN (%d/%d votes) — leading "
+            "failover epoch %d",
+            self.sentinel_id, primary, votes, self.quorum, new_epoch,
+        )
+        self._do_failover(new_epoch, primary)
+
+    def _verify_promoted(self, addr: str, epoch: int) -> bool:
+        """Did a Promote that timed out client-side land anyway? Poll the
+        candidate's Health briefly for ``role=primary`` at (or past) the
+        election epoch."""
+        deadline = time.monotonic() + self.promote_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                h = self._node(addr, "Health", {})
+                if (
+                    h.get("role") == "primary"
+                    and int(h.get("epoch") or 0) >= epoch
+                ):
+                    return True
+            except grpc.RpcError:
+                pass
+            time.sleep(min(0.2, self.poll_s))
+        return False
+
+    def _replica_cursor(self, addr: str) -> Optional[int]:
+        """Catch-up metric for candidate ranking: the replica's applied
+        cursor (higher = fresher; lowest repl_lag_seq by construction)."""
+        try:
+            h = self._node(addr, "Health", {})
+        except grpc.RpcError:
+            return None
+        repl = h.get("replication") or {}
+        cursor = repl.get("cursor")
+        return int(cursor) if cursor is not None else 0
+
+    def _do_failover(self, epoch: int, old_primary: str) -> None:
+        with self._lock:
+            candidates = [
+                a for a in self.topology.replicas if a != old_primary
+            ]
+        ranked = sorted(
+            (
+                (cursor, addr)
+                for addr in candidates
+                if (cursor := self._replica_cursor(addr)) is not None
+            ),
+            key=lambda t: (-t[0], t[1]),
+        )
+        if not ranked:
+            log.error(
+                "failover epoch %d: no reachable replica to promote", epoch
+            )
+            return
+        for cursor, winner in ranked:
+            try:
+                resp = self._node(
+                    winner,
+                    "Promote",
+                    {"epoch": epoch},
+                    timeout=self.promote_timeout_s,
+                )
+            except grpc.RpcError as e:
+                # a timed-out Promote may still have LANDED (it is not
+                # idempotent to just try the next candidate — that is
+                # how co-primaries duel). Verify before moving on.
+                if self._verify_promoted(winner, epoch):
+                    resp = {"ok": True}
+                else:
+                    log.warning(
+                        "failover epoch %d: promoting %s failed (%s); "
+                        "trying the next candidate",
+                        epoch, winner, getattr(e, "code", lambda: e)(),
+                    )
+                    continue
+            if not resp.get("ok"):
+                log.warning(
+                    "failover epoch %d: %s refused promotion: %s",
+                    epoch, winner, resp.get("error"),
+                )
+                continue
+            survivors = [a for a in candidates if a != winner]
+            with self._lock:
+                self.topology = Topology(
+                    epoch=epoch, primary=winner, replicas=list(survivors)
+                )
+                self._sdown = False
+                self._first_fail = None
+                self._fence_watch.add(old_primary)
+            self.failovers += 1
+            _counters.incr("sentinel_failovers")
+            log.warning(
+                "failover epoch %d: promoted %s (cursor %s); re-pointing "
+                "%d survivor(s)",
+                epoch, winner, cursor, len(survivors),
+            )
+            for addr in survivors:
+                try:
+                    self._node(
+                        addr,
+                        "ReplicaOf",
+                        {"primary": winner, "epoch": epoch},
+                        timeout=self.promote_timeout_s,
+                    )
+                except grpc.RpcError:
+                    log.warning(
+                        "failover epoch %d: could not re-point %s (it "
+                        "will be fenced/re-pointed when reachable)",
+                        epoch, addr,
+                    )
+                    with self._lock:
+                        self._fence_watch.add(addr)
+            announce = {
+                **self.topology.to_dict(),
+                "fenced": old_primary,
+                "leader": self.sentinel_id,
+            }
+            for peer in self.peers:
+                try:
+                    self._peer(peer, "AnnounceTopology", announce)
+                except grpc.RpcError:
+                    pass
+            return
+        log.error("failover epoch %d: every candidate refused", epoch)
+
+
+def main(argv: Optional[list] = None) -> None:
+    """``python -m tpubloom.sentinel --watch HOST:PORT [--peers A B ...]
+    [--port N] [--quorum N] [--down-after S] [--poll S]``"""
+    import sys as _sys
+
+    parser = argparse.ArgumentParser(
+        prog="tpubloom.sentinel",
+        description="tpubloom failover watcher (Redis Sentinel parity)",
+    )
+    parser.add_argument(
+        "--watch", required=True, metavar="HOST:PORT",
+        help="the primary to monitor",
+    )
+    parser.add_argument(
+        "--peers", nargs="*", default=[], metavar="HOST:PORT",
+        help="the other sentinels of the quorum",
+    )
+    parser.add_argument(
+        "--port", type=int, default=26379,
+        help="this sentinel's gRPC port (default 26379)",
+    )
+    parser.add_argument(
+        "--quorum", type=int, default=None,
+        help="votes needed for ODOWN+failover (default: majority)",
+    )
+    parser.add_argument(
+        "--down-after", type=float, default=1.5,
+        help="seconds of failed polls before SDOWN (default 1.5)",
+    )
+    parser.add_argument(
+        "--poll", type=float, default=0.25,
+        help="health poll interval in seconds (default 0.25)",
+    )
+    args = parser.parse_args(
+        list(_sys.argv[1:]) if argv is None else list(argv)
+    )
+    logging.basicConfig(level=logging.INFO)
+    faults.load_env()
+    sentinel = Sentinel(
+        args.watch,
+        args.peers,
+        listen=f"0.0.0.0:{args.port}",
+        quorum=args.quorum,
+        poll_s=args.poll,
+        down_after_s=args.down_after,
+    ).start()
+    log.info("sentinel serving on :%d", sentinel.port)
+    stop = threading.Event()
+    import signal
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda signum, frame: stop.set())
+    stop.wait()
+    sentinel.stop()

@@ -23,6 +23,8 @@ from typing import Optional
 
 import jax
 
+from tpubloom import faults
+
 log = logging.getLogger("tpubloom.distributed")
 
 
@@ -47,6 +49,9 @@ def initialize_multihost(
     Call once per host before building meshes. Returns a topology summary
     dict (host count, device counts).
     """
+    # chaos hook (ISSUE 4 satellite): a multi-host bring-up that dies at
+    # the coordinator join is a distinct failure class from a shard fault
+    faults.fire("dist.initialize")
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

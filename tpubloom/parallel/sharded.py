@@ -51,6 +51,7 @@ except ImportError:  # jax < 0.6: experimental namespace + check_rep kwarg
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpubloom import faults
 from tpubloom.config import FilterConfig
 from tpubloom.filter import _FilterBase
 from tpubloom.ops import bitops, blocked, counting, hashing
@@ -625,11 +626,45 @@ class ShardedBloomFilter(_FilterBase):
         self.words = jax.device_put(jnp.zeros_like(self.words), self.sharding)
         self.n_inserted = 0
 
+    # -- per-shard fault points (ISSUE 4 satellite) --------------------------
+
+    def _fire_shard_faults(self, point: str, keys) -> None:
+        """Chaos hook: fire ``point`` once per shard this batch routes
+        to, with ``shard=<index>`` context — an armed ``shard=N``
+        predicate turns it into a PARTIAL failure (batches that touch
+        shard N fail, everything else proceeds). Disarmed cost is one
+        dict lookup; the host-side routing hash only runs while armed."""
+        if not faults.is_armed(point):
+            return
+        keys_u8, lengths, _ = self._pack_padded(keys)
+        routes = np.asarray(
+            hashing.route_shards(
+                jnp.asarray(keys_u8),
+                jnp.asarray(np.maximum(lengths, 0)),
+                n_shards=self.config.shards,
+                seed=self.config.seed,
+            )
+        )
+        touched = sorted(
+            {int(s) for s, ln in zip(routes, lengths) if ln >= 0}
+        )
+        for shard in touched:
+            faults.fire(point, shard=shard)
+
+    def insert_batch(self, keys, **kwargs):
+        self._fire_shard_faults("shard.insert", keys)
+        return super().insert_batch(keys, **kwargs)
+
+    def include_batch(self, keys):
+        self._fire_shard_faults("shard.query", keys)
+        return super().include_batch(keys)
+
     # delete (counting configs only — configs 4 x 5)
 
     def delete_batch(self, keys) -> None:
         if not self.config.counting:
             raise ValueError("delete requires a counting config")
+        self._fire_shard_faults("shard.delete", keys)
         keys_u8, lengths, B = self._pack_padded(keys)
         self.words = self._delete(self.words, keys_u8, lengths)
         self.n_inserted = max(0, self.n_inserted - B)

@@ -29,7 +29,11 @@ from tpubloom.repl.log import OpLog
 from tpubloom.repl.monitor import MonitorHub, monitor_stream
 from tpubloom.repl.primary import ReplicaSessions, repl_stream
 from tpubloom.repl.record import decode_record, encode_record, scan_buffer
-from tpubloom.repl.replica import ReplicaApplier
+from tpubloom.repl.replica import (
+    ReplicaApplier,
+    ReplicaStateStore,
+    bootstrap_from_local,
+)
 
 __all__ = [
     "OpLog",
@@ -38,6 +42,8 @@ __all__ = [
     "ReplicaSessions",
     "repl_stream",
     "ReplicaApplier",
+    "ReplicaStateStore",
+    "bootstrap_from_local",
     "decode_record",
     "encode_record",
     "scan_buffer",

@@ -56,7 +56,12 @@ class OpLog:
         *,
         segment_bytes: int = DEFAULT_SEGMENT_BYTES,
         fsync: bool = False,
+        start_seq: int = 0,
     ):
+        """``start_seq`` seeds an EMPTY log's sequence space (promotion:
+        a replica adopting the op log opens a fresh log at its applied
+        seq so downstream cursors stay meaningful); ignored when the
+        directory already holds records."""
         self.directory = directory
         self.segment_bytes = segment_bytes
         self.fsync = fsync
@@ -70,6 +75,8 @@ class OpLog:
         self._segments: list[tuple[int, str]] = []
         self.last_seq = 0
         rewound = self._recover()
+        if not self._segments and start_seq > self.last_seq:
+            self.last_seq = start_seq
         self._bytes = sum(
             os.path.getsize(p) for _, p in self._segments if os.path.exists(p)
         )
@@ -79,6 +86,16 @@ class OpLog:
         #: had to truncate/drop records — the seq space rewound, so an
         #: old cursor would silently swallow new records.
         self.log_id = self._load_log_id(rotate=rewound)
+        #: PSYNC2-parity secondary identity (Redis replid2): after a
+        #: promotion, cursors pinned to the PREVIOUS primary's log id are
+        #: still resumable up to ``alias_upto`` — the promoted node's log
+        #: holds the same records in the same seq space up to that point.
+        self.alias_id: Optional[str] = None
+        self.alias_upto = 0
+        if rewound:
+            self._drop_alias()
+        else:
+            self._load_alias()
         self._update_gauges()
 
     def _load_log_id(self, rotate: bool) -> str:
@@ -99,6 +116,62 @@ class OpLog:
             f.write(new_id)
         os.replace(tmp, path)
         return new_id
+
+    # -- identity alias (failover continuity, Redis replid2 parity) ----------
+
+    def _alias_path(self) -> str:
+        return os.path.join(self.directory, "oplog.alias.json")
+
+    def _load_alias(self) -> None:
+        import json
+
+        try:
+            with open(self._alias_path()) as f:
+                data = json.load(f)
+            self.alias_id = data["log_id"] or None
+            self.alias_upto = int(data["upto"])
+        except (OSError, ValueError, KeyError, TypeError):
+            self.alias_id, self.alias_upto = None, 0
+
+    def _drop_alias(self) -> None:
+        self.alias_id, self.alias_upto = None, 0
+        try:
+            os.unlink(self._alias_path())
+        except OSError:
+            pass
+
+    def set_alias(self, log_id: Optional[str], upto: int) -> None:
+        """Remember that this log's records up to ``upto`` are identical
+        to log identity ``log_id`` (the upstream a promoted replica was
+        following) — cursors pinned to that id partial-resync instead of
+        paying a full resync after failover."""
+        import json
+
+        if not log_id:
+            return
+        self.alias_id, self.alias_upto = log_id, int(upto)
+        tmp = self._alias_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"log_id": log_id, "upto": int(upto)}, f)
+        os.replace(tmp, self._alias_path())
+
+    def resumable(self, cursor: int, log_id: Optional[str]) -> bool:
+        """True iff a replica at ``(cursor, log_id)`` can partial-resync
+        from this log: the identity matches (directly, or through the
+        post-promotion alias within its validity window) AND every record
+        past the cursor is still on disk."""
+        with self._cond:
+            if log_id == self.log_id:
+                pass
+            elif (
+                self.alias_id is not None
+                and log_id == self.alias_id
+                and cursor <= self.alias_upto
+            ):
+                pass
+            else:
+                return False
+        return self.has_cursor(cursor)
 
     # -- recovery ------------------------------------------------------------
 
@@ -190,6 +263,67 @@ class OpLog:
             self._cond.notify_all()
             self._update_gauges_locked()
         return seq
+
+    def append_record(self, record: dict) -> bool:
+        """Re-append one already-sequenced record VERBATIM (chained
+        replicas: the upstream's seq space IS this log's seq space, which
+        is what makes promoting a mid-chain node cheap). Returns False
+        when the record is already in the log (partial-resync overlap);
+        raises on a sequence gap — the caller must full-resync, a gap
+        must never be papered over."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("op log is closed")
+            seq = int(record["seq"])
+            if seq <= self.last_seq:
+                return False
+            if seq != self.last_seq + 1:
+                raise ValueError(
+                    f"op log gap: re-append of seq {seq} onto last_seq "
+                    f"{self.last_seq}"
+                )
+            frame = rec.encode_record(record)
+            if self._fh is None or self._size >= self.segment_bytes:
+                self._roll(seq)
+            self._fh.write(frame)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._size += len(frame)
+            self._bytes += len(frame)
+            self.last_seq = seq
+            self._cond.notify_all()
+            self._update_gauges_locked()
+        return True
+
+    def reset_to(self, seq: int) -> None:
+        """Full-resync state reset: drop EVERY record, restart the seq
+        space at ``seq``, and rotate the identity (this log's history is
+        no longer a prefix of anything a downstream cursor could have
+        followed)."""
+        import secrets
+
+        with self._cond:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            for _, path in self._segments:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._segments = []
+            self._size = 0
+            self._bytes = 0
+            self.last_seq = int(seq)
+            self.log_id = secrets.token_hex(16)
+            tmp = os.path.join(self.directory, "oplog.id.tmp")
+            with open(tmp, "w") as f:
+                f.write(self.log_id)
+            os.replace(tmp, os.path.join(self.directory, "oplog.id"))
+            self._drop_alias()
+            self._cond.notify_all()
+            self._update_gauges_locked()
 
     def _roll(self, start_seq: int) -> None:
         """Start a new segment whose first record will be ``start_seq``

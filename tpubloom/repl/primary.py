@@ -33,6 +33,9 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import zlib
+
+import msgpack
 
 from tpubloom import faults
 from tpubloom.obs import counters as _counters
@@ -43,6 +46,12 @@ DEFAULT_HEARTBEAT_S = 0.5
 #: Max records per poll round before re-checking liveness/cancellation.
 STREAM_BATCH = 256
 
+#: Capability flag a replica sends to opt into coalesced+compressed
+#: record frames (ISSUE 4 satellite — WAN links). Negotiated: the
+#: primary only batches when the replica advertised it AND the server
+#: was started with ``--repl-batch-bytes``.
+CAP_BATCH_ZLIB = "batch-zlib"
+
 
 class ReplicaSessions:
     """Connected-replica registry: addresses, cursors, lag gauges."""
@@ -52,11 +61,15 @@ class ReplicaSessions:
         self._ids = itertools.count()
         self._sessions: dict[int, dict] = {}
 
-    def register(self, peer: str) -> int:
+    def register(self, peer: str, listen: str | None = None) -> int:
+        """``listen`` is the replica's ANNOUNCED serving address (its
+        gRPC listener, not the ephemeral peer port) — what sentinels
+        discover replicas by, Redis ``replica-announce-ip/port`` parity."""
         with self._lock:
             sid = next(self._ids)
             self._sessions[sid] = {
                 "peer": peer,
+                "listen": listen,
                 "cursor": 0,
                 "connected_at": time.time(),
             }
@@ -96,6 +109,41 @@ class ReplicaSessions:
             return [dict(s) for s in self._sessions.values()]
 
 
+def _batched_frames(records: list, batch_bytes: int):
+    """Coalesce records into ``{"kind": "records", "z": <zlib level-1 of
+    a msgpack record list>, ...}`` frames of roughly ``batch_bytes`` of
+    raw payload each (one oversized record still ships alone). Level 1:
+    op records are msgpack maps full of repeated keys and key bytes —
+    cheap compression wins most of what's winnable, and the stream stays
+    CPU-light."""
+    group: list = []
+    group_bytes = 0
+    for r in records:
+        size = len(msgpack.packb(r, use_bin_type=True))
+        if group and group_bytes + size > batch_bytes:
+            yield _pack_group(group)
+            group, group_bytes = [], 0
+        group.append(r)
+        group_bytes += size
+    if group:
+        yield _pack_group(group)
+
+
+def _pack_group(group: list) -> dict:
+    raw = msgpack.packb(group, use_bin_type=True)
+    z = zlib.compress(raw, 1)
+    _counters.incr("repl_stream_batched_frames")
+    _counters.incr("repl_stream_batched_bytes_raw", len(raw))
+    _counters.incr("repl_stream_batched_bytes_wire", len(z))
+    return {
+        "kind": "records",
+        "z": z,
+        "count": len(group),
+        "first_seq": group[0]["seq"],
+        "last_seq": group[-1]["seq"],
+    }
+
+
 def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEARTBEAT_S):
     """Generator behind the ``ReplStream`` RPC (dicts; the server layer
     msgpack-encodes each one)."""
@@ -110,16 +158,21 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
         return
     sessions: ReplicaSessions = service.repl_sessions
     cursor = req.get("cursor")
-    sid = sessions.register(getattr(context, "peer", lambda: "?")())
+    caps = set(req.get("caps") or ())
+    batch_bytes = getattr(service, "repl_batch_bytes", None)
+    use_batch = bool(batch_bytes) and CAP_BATCH_ZLIB in caps
+    sid = sessions.register(
+        getattr(context, "peer", lambda: "?")(), listen=req.get("listen")
+    )
     try:
         # a cursor is only resumable against the SAME log identity
         # (Redis replid parity): a rewound/recreated log reuses seq
-        # numbers, so a stale-id cursor would silently swallow records
-        if (
-            cursor is None
-            or req.get("log_id") != oplog.log_id
-            or not oplog.has_cursor(cursor)
-        ):
+        # numbers, so a stale-id cursor would silently swallow records.
+        # Post-failover, the promoted node's ALIAS (replid2 parity)
+        # extends "same identity" to the old primary's id up to the
+        # promotion point — survivors partial-resync instead of paying
+        # a full resync.
+        if cursor is None or not oplog.resumable(cursor, req.get("log_id")):
             _counters.incr("repl_full_resyncs")
             names, snaps, plan_seq = service.snapshot_plan()
             yield {
@@ -148,6 +201,7 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                 "kind": "full_sync_end",
                 "cursor": cursor,
                 "log_id": oplog.log_id,
+                "epoch": getattr(service, "epoch", 0),
             }
         else:
             _counters.incr("repl_partial_resyncs")
@@ -155,15 +209,31 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                 "kind": "partial_sync",
                 "cursor": cursor,
                 "log_id": oplog.log_id,
+                "epoch": getattr(service, "epoch", 0),
             }
         sessions.update(sid, cursor, oplog.last_seq)
         follower = oplog.follower(cursor)
+        stream_log_id = oplog.log_id
         while context.is_active() and not service.draining:
+            if oplog.log_id != stream_log_id:
+                # the log identity rotated UNDER this stream (a chained
+                # upstream full-resynced and reset its log): the
+                # subscriber's cursor belongs to the old identity — end
+                # the stream so its reconnect re-handshakes (and pays
+                # the full resync the reset implies)
+                _counters.incr("repl_stream_cut_identity_rotated")
+                return
             batch = follower.next_batch(STREAM_BATCH)
-            for rec in batch:
-                faults.fire("repl.stream_send")
-                yield {"kind": "record", **rec}
-                _counters.incr("repl_records_streamed")
+            if use_batch and len(batch) > 1:
+                for frame in _batched_frames(batch, batch_bytes):
+                    faults.fire("repl.stream_send")
+                    yield frame
+                _counters.incr("repl_records_streamed", len(batch))
+            else:
+                for rec in batch:
+                    faults.fire("repl.stream_send")
+                    yield {"kind": "record", **rec}
+                    _counters.incr("repl_records_streamed")
             cursor = follower.cursor
             sessions.update(sid, cursor, oplog.last_seq)
             if not batch and not oplog.wait_for(
@@ -173,6 +243,7 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                     "kind": "heartbeat",
                     "seq": oplog.last_seq,
                     "ts": time.time(),
+                    "epoch": getattr(service, "epoch", 0),
                 }
     finally:
         sessions.unregister(sid)

@@ -26,15 +26,19 @@ server read-only (writes get ``READONLY``, Redis parity) with one
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
+import zlib
 from typing import Optional
 
 import grpc
+import msgpack
 
 from tpubloom.obs import counters as _counters
 from tpubloom.server import protocol
+from tpubloom.utils import crcjson
 
 log = logging.getLogger("tpubloom.repl")
 
@@ -42,21 +46,115 @@ log = logging.getLogger("tpubloom.repl")
 class FullResyncNeeded(Exception):
     """Raised by the apply path when a record's effect cannot be derived
     from the stream alone — e.g. a ``CreateFilter`` that bootstrapped
-    state from a checkpoint the replica does not have. The applier drops
+    state from a checkpoint the replica does not have, or a chained
+    replica's local log refusing a gapped re-append. The applier drops
     its cursor and reconnects: the full-resync snapshot carries the
     state the record could not."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, reason: Optional[str] = None):
         super().__init__(
-            f"record for filter {name!r} references state only a full "
+            reason
+            or f"record for filter {name!r} references state only a full "
             f"resync can transfer"
         )
         self.name = name
 
 
+class ReplicaStateStore:
+    """Replica-side persistence of the replication cursor (ISSUE 4
+    satellite): ``<dir>/repl_cursor.json`` holds the last fully-applied
+    seq + the primary log identity it belongs to, CRC32C-checked so a
+    torn write reads as "no cursor" (→ full resync — the safe
+    direction) rather than a bogus resume point. With it, a replica
+    restart bootstraps from its local checkpoints and PARTIAL-resyncs
+    instead of always paying a full one."""
+
+    CURSOR_FILE = "repl_cursor.json"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, self.CURSOR_FILE)
+
+    def load(self) -> Optional[dict]:
+        """``{"cursor": int, "log_id": str}`` or None (absent/corrupt)."""
+        data = crcjson.load(self.path, ("cursor", "log_id"))
+        if data is None:
+            return None
+        try:
+            return {"cursor": int(data["cursor"]), "log_id": data["log_id"]}
+        except (ValueError, TypeError):
+            return None
+
+    def store(self, cursor: int, log_id: Optional[str]) -> None:
+        if log_id is None:
+            return
+        crcjson.store(self.path, {"cursor": int(cursor), "log_id": log_id})
+
+    def clear(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def bootstrap_from_local(service, state_store: Optional[ReplicaStateStore]):
+    """Restart path of a replica with local durability: rebuild state
+    from the creation manifest + local checkpoints (chained replicas:
+    the caller already ran ``replay_oplog``) and return the
+    ``(cursor, log_id)`` to resume the stream from — or ``(None, None)``
+    when only a full resync is safe.
+
+    The resume cursor is the MIN over restored filters of the op seq
+    their restored bytes cover: every record at or below it is contained
+    in some filter's restored state (per-filter ``repl_seq`` gates skip
+    the overlap above it), so nothing is lost and nothing double-applies.
+    """
+    saved = state_store.load() if state_store is not None else None
+    if saved is None or not saved.get("log_id"):
+        return None, None
+    if service.oplog is not None:
+        # chained replica: replay already drove the local log over the
+        # restored checkpoints — state coverage IS the log head
+        return service.oplog.last_seq, saved["log_id"]
+    manifest = service._manifest_read() or {}
+    if not manifest:
+        # empty filter set at the persisted cursor is exactly the state
+        return saved["cursor"], saved["log_id"]
+    seqs = []
+    for name, create_req in manifest.items():
+        try:
+            service.CreateFilter(
+                {**create_req, "exist_ok": True, "restore": True}
+            )
+        except Exception:
+            log.exception(
+                "replica bootstrap: re-creating filter %r failed — "
+                "falling back to a full resync", name,
+            )
+            return None, None
+        mf = service._filters.get(name)
+        if mf is None or mf.applied_seq <= 0:
+            # no restorable checkpoint for this filter: its state cannot
+            # be rebuilt locally, only a full resync carries it
+            return None, None
+        seqs.append(mf.applied_seq)
+    cursor = min(seqs)
+    _counters.incr("repl_bootstrap_partial_resyncs")
+    log.info(
+        "replica bootstrap: %d filter(s) restored locally; resuming the "
+        "stream from seq %d", len(seqs), cursor,
+    )
+    return cursor, saved["log_id"]
+
+
 class ReplicaApplier:
     """Background thread that keeps a local (read-only) service in sync
     with a primary."""
+
+    #: applied records between throttled cursor persists (the gates make
+    #: a stale persisted cursor merely re-stream records, never re-apply)
+    PERSIST_EVERY = 64
 
     def __init__(
         self,
@@ -65,18 +163,26 @@ class ReplicaApplier:
         *,
         reconnect_base: float = 0.2,
         reconnect_max: float = 5.0,
+        state_store: Optional[ReplicaStateStore] = None,
+        listen_address: Optional[str] = None,
+        initial_cursor: Optional[int] = None,
+        initial_log_id: Optional[str] = None,
     ):
         self.service = service
         self.primary_address = primary_address
         self.reconnect_base = reconnect_base
         self.reconnect_max = reconnect_max
+        #: replica-side cursor persistence (ISSUE 4 satellite)
+        self.state_store = state_store
+        #: this replica's announced serving address (sentinel discovery)
+        self.listen_address = listen_address
         #: last op seq fully applied (the reconnect cursor); None until
         #: the first successful sync
-        self.cursor: Optional[int] = None
+        self.cursor: Optional[int] = initial_cursor
         #: the primary log identity the cursor belongs to (Redis replid
         #: parity) — echoed on reconnect; a primary whose log identity
         #: changed (rewound/recreated) answers with a full resync
-        self.log_id: Optional[str] = None
+        self.log_id: Optional[str] = initial_log_id
         self.head_seq = 0
         self.link = "connecting"
         self.full_syncs = 0
@@ -84,6 +190,7 @@ class ReplicaApplier:
         self.records_applied = 0
         self.records_skipped = 0
         self.last_sync_kind: Optional[str] = None
+        self._since_persist = 0
         self._stop = threading.Event()
         self._call = None
         self._call_lock = threading.Lock()
@@ -92,6 +199,9 @@ class ReplicaApplier:
         )
         service.replica_applier = self
         service.primary_address = primary_address
+        #: from here on the local op log (if any) is fed by reappend —
+        #: handler-side appends would mint conflicting seqs
+        service._stream_fed = True
 
     def start(self) -> "ReplicaApplier":
         self._thread.start()
@@ -102,7 +212,23 @@ class ReplicaApplier:
         with self._call_lock:
             if self._call is not None:
                 self._call.cancel()
-        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        self._persist_cursor(force=True)
+
+    def _persist_cursor(self, force: bool = False) -> None:
+        """Throttled write of the resume point (every PERSIST_EVERY
+        applied records + every sync transition + on stop): staler only
+        costs re-streamed records — the seq gates absorb them."""
+        if self.state_store is None or self.cursor is None:
+            return
+        self._since_persist += 1
+        if force or self._since_persist >= self.PERSIST_EVERY:
+            self._since_persist = 0
+            try:
+                self.state_store.store(self.cursor, self.log_id)
+            except OSError:
+                log.exception("repl cursor persist failed (non-fatal)")
 
     def status(self) -> dict:
         return {
@@ -162,7 +288,9 @@ class ReplicaApplier:
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b,
             )
-            req: dict = {}
+            req: dict = {"caps": ["batch-zlib"]}
+            if self.listen_address:
+                req["listen"] = self.listen_address
             if self.cursor is not None:
                 req["cursor"] = self.cursor
                 req["log_id"] = self.log_id
@@ -224,28 +352,36 @@ class ReplicaApplier:
             self.service.retain_only(self._sync_filters)
             self.cursor = msg["cursor"]
             self.log_id = msg.get("log_id")
+            if self.service.oplog is not None:
+                # chained: the local log's history is no longer a prefix
+                # of anything real — wipe it, restart the seq space at
+                # the resync cursor, rotate its identity so downstream
+                # cursors full-resync too (their state reset with ours)
+                self.service.oplog.reset_to(self.cursor)
+            self._adopt_epoch(msg)
             self.link = "connected"
+            self._persist_cursor(force=True)
         elif kind == "partial_sync":
             self.last_sync_kind = "partial"
             self.partial_syncs += 1
             self.cursor = msg["cursor"]
             self.log_id = msg.get("log_id")
+            self._adopt_epoch(msg)
             self.link = "connected"
+            self._persist_cursor(force=True)
         elif kind == "record":
-            applied = self.service.apply_record(msg)
-            if applied:
-                self.records_applied += 1
-                _counters.incr("repl_records_applied")
-            else:
-                self.records_skipped += 1
-                _counters.incr("repl_records_skipped")
-            self.cursor = msg["seq"]
-            self.head_seq = max(self.head_seq, msg["seq"])
-            _counters.set_gauge(
-                "repl_lag_seconds", max(0.0, time.time() - msg.get("ts", 0))
+            self._handle_record(msg)
+        elif kind == "records":
+            # coalesced+compressed frame (negotiated "batch-zlib" cap)
+            records = msgpack.unpackb(
+                zlib.decompress(msg["z"]), raw=False
             )
+            _counters.incr("repl_batched_frames_received")
+            for rec in records:
+                self._handle_record(rec)
         elif kind == "heartbeat":
             self.head_seq = max(self.head_seq, msg["seq"])
+            self._adopt_epoch(msg)
             if self.cursor is not None and self.head_seq <= self.cursor:
                 _counters.set_gauge("repl_lag_seconds", 0.0)
         elif kind == "error":
@@ -254,4 +390,37 @@ class ReplicaApplier:
             )
         _counters.set_gauge(
             "repl_lag_seq", max(0, self.head_seq - (self.cursor or 0))
+        )
+
+    def _adopt_epoch(self, msg: dict) -> None:
+        """Sync/heartbeat frames carry the primary's topology epoch —
+        replicas learn it passively, so a bare replica still fences
+        stale ``Promote``/``ReplicaOf`` requests correctly."""
+        epoch = msg.get("epoch")
+        if epoch:
+            self.service.adopt_epoch(int(epoch))
+
+    def _handle_record(self, rec: dict) -> None:
+        """One op record: re-append to the local log first when chained
+        (write-ahead — replay is idempotent, a logged-but-unapplied
+        record is healed by the seq gates at restart), then apply."""
+        if self.service.oplog is not None:
+            try:
+                self.service.reappend_record(rec)
+            except ValueError as e:
+                # seq gap against the local log: only a full resync can
+                # restore a coherent prefix — never paper over a gap
+                raise FullResyncNeeded("<oplog>", reason=str(e))
+        applied = self.service.apply_record(rec)
+        if applied:
+            self.records_applied += 1
+            _counters.incr("repl_records_applied")
+        else:
+            self.records_skipped += 1
+            _counters.incr("repl_records_skipped")
+        self.cursor = rec["seq"]
+        self.head_seq = max(self.head_seq, rec["seq"])
+        self._persist_cursor()
+        _counters.set_gauge(
+            "repl_lag_seconds", max(0.0, time.time() - rec.get("ts", 0))
         )

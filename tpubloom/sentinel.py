@@ -1,0 +1,13 @@
+"""``python -m tpubloom.sentinel --watch host:port --peers ...``
+
+Thin entry point for the failover watcher; the implementation lives in
+:mod:`tpubloom.ha.sentinel` (quorum votes, most-caught-up promotion,
+survivor re-pointing, stale-primary fencing).
+"""
+
+from tpubloom.ha.sentinel import Sentinel, main
+
+__all__ = ["Sentinel", "main"]
+
+if __name__ == "__main__":
+    main()

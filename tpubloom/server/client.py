@@ -90,6 +90,31 @@ _CHANNEL_OPTIONS = [
 _BREAKER_GAUGE = {"closed": 0, "half-open": 1, "open": 2}
 
 
+def fetch_topology(
+    sentinels: Sequence[str], *, timeout: float = 2.0
+) -> Optional[dict]:
+    """Ask each sentinel for the current cluster view (``SENTINEL
+    get-master-addr-by-name`` parity); first answer wins. Returns
+    ``{"epoch", "primary", "replicas"}`` or None when no sentinel is
+    reachable."""
+    for addr in sentinels:
+        channel = grpc.insecure_channel(addr)
+        try:
+            raw = channel.unary_unary(
+                protocol.sentinel_method_path("Topology"),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )(protocol.encode({}), timeout=timeout)
+            resp = protocol.decode(raw)
+            if resp.get("ok") and resp.get("primary"):
+                return resp
+        except grpc.RpcError:
+            continue
+        finally:
+            channel.close()
+    return None
+
+
 class CircuitOpenError(protocol.BloomServiceError):
     """Raised without touching the network while the breaker is open."""
 
@@ -206,7 +231,7 @@ class BloomClient:
 
     def __init__(
         self,
-        address: str = "127.0.0.1:50051",
+        address: Optional[str] = None,
         *,
         timeout: float = 60.0,
         max_retries: int = 5,
@@ -216,16 +241,49 @@ class BloomClient:
         breaker_cooldown: float = 5.0,
         replicas: Optional[Sequence[str]] = None,
         read_preference: str = "primary",
+        sentinels: Optional[Sequence[str]] = None,
+        topology: Optional[dict] = None,
     ):
         """``replicas`` + ``read_preference="replica"`` route QueryBatch
         traffic round-robin over read replicas (writes always hit
         ``address``); a failing replica falls back to the primary for
-        that call."""
+        that call.
+
+        Topology-awareness (ISSUE 4): pass ``sentinels=[addr, ...]``
+        (resolved + cached with its epoch; refreshed on ``READONLY`` /
+        ``UNAVAILABLE`` / ``STALE_EPOCH``, so writes fail over to the
+        new primary — rid-dedup server-side guarantees a re-driven
+        acknowledged batch never double-applies) or a static
+        ``topology={"epoch", "primary", "replicas"}``. Either may stand
+        in for ``address``/``replicas``."""
         if read_preference not in ("primary", "replica"):
             raise ValueError(
                 f"read_preference must be 'primary' or 'replica', "
                 f"got {read_preference!r}"
             )
+        self.sentinels = list(sentinels or ())
+        #: cached topology epoch — stamped on mutating requests so a
+        #: server under a newer topology answers STALE_EPOCH and we
+        #: refresh instead of writing under a stale map
+        self.epoch: Optional[int] = None
+        if topology is None and self.sentinels:
+            topology = fetch_topology(self.sentinels)
+        if topology is not None:
+            self.epoch = int(topology.get("epoch") or 0)
+            address = topology.get("primary") or address
+            if replicas is None:
+                replicas = topology.get("replicas")
+        if address is None:
+            if self.sentinels:
+                # the caller asked for sentinel-resolved routing: falling
+                # back to a hardcoded default here would silently connect
+                # to the wrong (or a stale) node
+                raise protocol.BloomServiceError(
+                    "NO_TOPOLOGY",
+                    f"no sentinel of {self.sentinels} answered and no "
+                    f"explicit address was given",
+                )
+            address = "127.0.0.1:50051"
         self.address = address
         self.timeout = timeout
         self.max_retries = max_retries
@@ -302,6 +360,42 @@ class BloomClient:
         old.close()
         obs_counters.incr("client_primary_redirects")
 
+    def _set_replicas(self, addrs: Sequence[str]) -> None:
+        """Replace the replica channel pool (topology refresh)."""
+        keep = {a: (a, ch, calls) for a, ch, calls in self._replicas}
+        fresh = []
+        for addr in addrs:
+            if addr in keep:
+                fresh.append(keep.pop(addr))
+            else:
+                ch = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+                fresh.append((addr, ch, self._make_calls(ch)))
+        for _, ch, _ in keep.values():
+            ch.close()
+        self._replicas = fresh
+        self._rr = 0
+
+    def refresh_topology(self) -> bool:
+        """Re-resolve the cluster view from the sentinel list; adopt it
+        iff its epoch is not older than the cached one. True iff the
+        PRIMARY changed (the signal that a retried write should reset
+        its backoff — it now targets a different process)."""
+        if not self.sentinels:
+            return False
+        topo = fetch_topology(self.sentinels)
+        if topo is None:
+            return False
+        epoch = int(topo.get("epoch") or 0)
+        if self.epoch is not None and epoch < self.epoch:
+            return False
+        self.epoch = epoch
+        obs_counters.incr("client_topology_refreshes")
+        changed = bool(topo.get("primary")) and topo["primary"] != self.address
+        if changed:
+            self._follow_primary(topo["primary"])
+        self._set_replicas(topo.get("replicas") or ())
+        return changed
+
     def _rpc(self, method: str, req: dict) -> dict:
         # request-correlation id: one per LOGICAL call (retries and the
         # NOT_FOUND heal's final retry share it); exposed as last_rid so
@@ -311,6 +405,8 @@ class BloomClient:
         # instead of re-applying.
         self.last_rid = rid = new_rid()
         req = {**req, "rid": rid}
+        if self.epoch is not None and method in protocol.MUTATING_METHODS:
+            req["epoch"] = self.epoch
         routed = self._try_replica(method, req)
         if routed is not None:
             return routed
@@ -318,6 +414,8 @@ class BloomClient:
         self.breaker.check(self.address)
         recreated = False
         redirected = False
+        failover_reset = False
+        stale_refreshed = False
         attempt = 0
         shed_attempt = 0
         while True:
@@ -326,6 +424,18 @@ class BloomClient:
                 self.breaker.record_success()
                 return resp
             except grpc.RpcError as e:
+                if e.code() is grpc.StatusCode.UNAVAILABLE and self.sentinels:
+                    # the primary may be mid-failover: re-resolve the
+                    # topology. A changed primary resets the retry budget
+                    # ONCE — the retry targets a different process, and
+                    # the rid guarantees an already-applied batch answers
+                    # from the dedup cache instead of double-applying.
+                    if self.refresh_topology() and not failover_reset:
+                        failover_reset = True
+                        attempt = 0
+                        if self.epoch is not None and "epoch" in req:
+                            req["epoch"] = self.epoch
+                        continue
                 if (
                     e.code() is not grpc.StatusCode.UNAVAILABLE
                     or attempt >= self.max_retries
@@ -342,6 +452,18 @@ class BloomClient:
             except protocol.BloomServiceError as e:
                 # an application-level answer means the transport is fine
                 self.breaker.record_success()
+                if e.code == "STALE_EPOCH" and not stale_refreshed:
+                    # our cached topology predates a failover: adopt the
+                    # server's epoch, re-resolve, retry once under the
+                    # fresh view
+                    stale_refreshed = True
+                    server_epoch = e.details.get("epoch")
+                    if server_epoch is not None:
+                        self.epoch = max(self.epoch or 0, int(server_epoch))
+                    self.refresh_topology()
+                    if self.epoch is not None and "epoch" in req:
+                        req["epoch"] = self.epoch
+                    continue
                 if e.code in _SHED_CODES:
                     # shed BEFORE execution — safe to replay any method,
                     # even the non-idempotent ones; pace off the server's
@@ -361,12 +483,19 @@ class BloomClient:
                 if e.code == "READONLY" and not redirected:
                     # the "primary" we were pointed at is a replica
                     # (failover, stale config). Its error advertises the
-                    # real primary — follow it once, Redis-MOVED-style.
+                    # real primary — follow it once, Redis-MOVED-style;
+                    # with sentinels, their view wins over the hint
+                    # (mid-failover a replica may not know its new
+                    # primary yet).
+                    redirected = True
+                    if self.sentinels and self.refresh_topology():
+                        if self.epoch is not None and "epoch" in req:
+                            req["epoch"] = self.epoch
+                        continue
                     primary = e.details.get("primary")
                     if not primary or primary == self.address:
                         raise
                     self._follow_primary(primary)
-                    redirected = True
                     continue
                 # Heal a restarted server: replay the remembered creation
                 # (restores the newest checkpoint), then retry the op once.
@@ -570,6 +699,35 @@ class BloomClient:
 
     def checkpoint(self, name: str, *, wait: bool = True) -> dict:
         return self._rpc("Checkpoint", {"name": name, "wait": wait})
+
+    # -- high availability (ISSUE 4) -----------------------------------------
+
+    def promote(
+        self,
+        *,
+        epoch: Optional[int] = None,
+        repl_log_dir: Optional[str] = None,
+    ) -> dict:
+        """Promote the server this client points at from replica to
+        primary (``REPLICAOF NO ONE`` parity). ``repl_log_dir`` names
+        the op-log dir the REMOTE process should adopt when it was
+        started without one."""
+        req: dict = {}
+        if epoch is not None:
+            req["epoch"] = epoch
+        if repl_log_dir:
+            req["repl_log_dir"] = repl_log_dir
+        return self._rpc("Promote", req)
+
+    def replica_of(
+        self, primary: Optional[str], *, epoch: Optional[int] = None
+    ) -> dict:
+        """Redis ``REPLICAOF`` parity: re-point the server at a new
+        primary (or pass None / ``"NO ONE"`` to promote it)."""
+        req: dict = {"primary": primary}
+        if epoch is not None:
+            req["epoch"] = epoch
+        return self._rpc("ReplicaOf", req)
 
     # -- observability -------------------------------------------------------
 

@@ -40,6 +40,8 @@ METHODS = (
     "Checkpoint",
     "SlowlogGet",
     "SlowlogReset",
+    "Promote",
+    "ReplicaOf",
 )
 
 #: Server-streaming RPCs (ISSUE 3): each response frame is one msgpack
@@ -54,10 +56,33 @@ STREAM_METHODS = (
 )
 
 #: Mutating RPCs: replicated through the op log, rejected with
-#: ``READONLY`` on replicas (Redis ``replica-read-only`` parity).
+#: ``READONLY`` on replicas (Redis ``replica-read-only`` parity). A
+#: mutating request MAY carry the caller's cached topology ``epoch``
+#: (ISSUE 4): a server whose epoch is newer answers ``STALE_EPOCH`` so
+#: topology-aware clients refresh instead of writing under a stale view.
 MUTATING_METHODS = frozenset(
     {"CreateFilter", "DropFilter", "InsertBatch", "DeleteBatch", "Clear"}
 )
+
+#: HA control-plane RPCs (ISSUE 4): ``Promote`` (replica→primary,
+#: ``REPLICAOF NO ONE`` parity) and ``ReplicaOf`` (re-point/demote,
+#: ``REPLICAOF host port`` parity). Epoch-stamped; stale epochs are
+#: rejected with ``STALE_EPOCH``. Deliberately NOT in MUTATING_METHODS
+#: (they must run on replicas) and never shed (a failover must land on
+#: an overloaded cluster).
+HA_METHODS = frozenset({"Promote", "ReplicaOf"})
+
+#: The sentinel coordinator's own little gRPC service (ISSUE 4):
+#: ``Topology`` (client-facing: the current epoch/primary/replicas —
+#: SENTINEL get-master-addr parity), ``VoteDown`` (epoch-stamped
+#: SDOWN→ODOWN leader vote), ``AnnounceTopology`` (post-failover view
+#: propagation), ``Ping`` (liveness).
+SENTINEL_SERVICE = "tpubloom.Sentinel"
+SENTINEL_METHODS = ("Ping", "Topology", "VoteDown", "AnnounceTopology")
+
+
+def sentinel_method_path(method: str) -> str:
+    return f"/{SENTINEL_SERVICE}/{method}"
 
 
 def encode(msg: dict) -> bytes:

@@ -67,6 +67,27 @@ Replication (ISSUE 3 — :mod:`tpubloom.repl`):
   that grows with the observed shed rate (the measurable queue-pressure
   signal once the in-flight cap is pegged) and decays back to the
   configured base when the burst passes.
+
+High availability (ISSUE 4 — :mod:`tpubloom.ha`):
+
+* **promotion / demotion** — the ``Promote`` RPC (``REPLICAOF NO ONE``
+  parity; also ``python -m tpubloom.server promote host:port``) flips a
+  replica to primary by adopting the op log and bumping the persisted
+  **topology epoch**; ``ReplicaOf`` re-points (or demotes) a node. Both
+  are epoch-stamped — stale epochs answer ``STALE_EPOCH`` (Raft term
+  discipline), which is also how a restarted pre-failover primary gets
+  fenced by a sentinel.
+* **chained replicas** — ``--replica-of`` + ``--repl-log-dir`` together:
+  applied records re-append to the local log in the upstream's seq
+  space (:meth:`BloomService.reappend_record`), so this node serves
+  ``ReplStream`` downstream and promotes in place.
+* **epoch fencing on the data plane** — a mutating request stamped with
+  an older topology epoch than this server's is rejected with
+  ``STALE_EPOCH`` so topology-aware clients refresh instead of writing
+  under a stale view.
+* **replica durability** — with a state dir, the replication cursor
+  (``repl_cursor.json``) and creation manifest persist; a replica
+  restart restores filters from local checkpoints and PARTIAL-resyncs.
 """
 
 from __future__ import annotations
@@ -128,10 +149,13 @@ class _Managed:
 
 
 #: RPCs that are never shed: Health must answer DURING overload or the
-#: operator flies blind, and the rest are cheap in-memory control-plane
-#: reads that hold no device buffers.
+#: operator flies blind, the reads are cheap in-memory control-plane
+#: lookups holding no device buffers, and the HA verbs (Promote /
+#: ReplicaOf) must land on an overloaded cluster — a failover that can
+#: be shed is not a failover.
 UNSHEDDABLE = frozenset(
-    {"Health", "ListFilters", "SlowlogGet", "SlowlogReset"}
+    {"Health", "ListFilters", "SlowlogGet", "SlowlogReset",
+     "Promote", "ReplicaOf"}
 )
 
 #: How long after the last shed Health keeps reporting the "shedding"
@@ -161,6 +185,9 @@ class BloomService:
         dedup_capacity: int = 1024,
         oplog=None,
         read_only: bool = False,
+        epoch: Optional[int] = None,
+        repl_batch_bytes: Optional[int] = None,
+        listen_address: Optional[str] = None,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -205,6 +232,44 @@ class BloomService:
         #: True while replay_oplog runs — replayed ops must not re-append
         self._replaying = False
         self._appends_since_truncate = 0
+        # -- high availability (ISSUE 4) --
+        #: topology epoch (Raft-term discipline): bumped+persisted at
+        #: every promotion; stale Promote/ReplicaOf/epoch-stamped writes
+        #: are rejected with STALE_EPOCH
+        from tpubloom.ha.topology import EpochStore
+
+        self._epoch_store = (
+            EpochStore(oplog.directory) if oplog is not None else None
+        )
+        self.epoch = (
+            int(epoch)
+            if epoch is not None
+            else (self._epoch_store.load() if self._epoch_store else 0)
+        )
+        obs_counters.set_gauge("ha_epoch", float(self.epoch))
+        obs_counters.set_gauge("ha_role", 1.0 if read_only else 0.0)
+        #: serializes role transitions (Promote / ReplicaOf)
+        self._promote_lock = threading.Lock()
+        #: where the creation manifest lives (the op log dir on nodes
+        #: with a log; a replica's durable state dir otherwise)
+        self._manifest_dir: Optional[str] = (
+            oplog.directory if oplog is not None else None
+        )
+        #: coalesce ReplStream records up to this many raw bytes per
+        #: zlib frame for replicas that negotiated the capability
+        self.repl_batch_bytes = repl_batch_bytes
+        #: this server's announced address (sentinel/replica discovery)
+        self.listen_address = listen_address
+        #: replica-side cursor persistence (set by main()/become_replica)
+        self.replica_state_store = None
+        #: True while the local op log is fed by a ReplicaApplier
+        #: (reappend_record preserves the upstream seq space) — handler-
+        #: side appends are suppressed then, or they would mint
+        #: conflicting seqs. Deliberately NOT the read_only flag: an
+        #: in-flight write that raced a demotion past the READONLY check
+        #: must still log (become_replica drains those before attaching
+        #: the applier), or its ack silently vanishes from the log.
+        self._stream_fed = read_only
         #: set (repr of the exception) when an op-log append fails AFTER
         #: its op applied in memory — state is now ahead of the log, so
         #: further writes are fail-stopped (Redis aborts writes on AOF
@@ -284,6 +349,73 @@ class BloomService:
         with self._admit_lock:
             self._draining = True
 
+    # -- high availability: epoch + chained re-append (ISSUE 4) --------------
+
+    def adopt_epoch(self, epoch: int) -> None:
+        """Advance (never rewind) the topology epoch, persisting when a
+        store is attached. Raft's term rule: whoever has seen the higher
+        epoch is right about the topology."""
+        if epoch <= self.epoch:
+            return
+        self.epoch = int(epoch)
+        if self._epoch_store is not None:
+            try:
+                self._epoch_store.store(self.epoch)
+            except OSError:
+                log.exception("epoch persist failed (non-fatal)")
+        obs_counters.set_gauge("ha_epoch", float(self.epoch))
+
+    def reappend_record(self, rec: dict) -> None:
+        """Chained replica: re-append one upstream record VERBATIM to the
+        local op log (same seq space — what makes mid-chain promotion
+        cheap and lets this node serve ``ReplStream`` downstream).
+        Raises ValueError on a seq gap (caller full-resyncs)."""
+        if self.oplog is None or self._replaying:
+            return
+        faults.fire("repl.reappend")
+        if self.oplog.append_record(rec):
+            obs_counters.incr("repl_records_reappended")
+            # checkpoint-keyed truncation must run here too — on a
+            # replica, _log_op (the primary-side sweep driver) never
+            # fires, and an unswept chained log grows without bound
+            self._appends_since_truncate += 1
+            if self._appends_since_truncate >= TRUNCATE_EVERY_APPENDS:
+                self._appends_since_truncate = 0
+                self._maybe_truncate_log()
+
+    def Promote(self, req: dict) -> dict:
+        """Replica→primary promotion RPC (``REPLICAOF NO ONE`` parity):
+        adopt the op log, bump+persist the topology epoch, start taking
+        writes and serving ``ReplStream``. Idempotent on a primary;
+        ``epoch`` (optional) pins the sentinel-agreed epoch and stale
+        values are rejected with ``STALE_EPOCH``."""
+        from tpubloom.ha import promotion
+
+        return promotion.promote_to_primary(
+            self,
+            repl_log_dir=req.get("repl_log_dir"),
+            epoch=req.get("epoch"),
+        )
+
+    def ReplicaOf(self, req: dict) -> dict:
+        """Redis ``REPLICAOF`` parity: ``{"primary": "host:port"}``
+        re-points (or demotes) this server to replicate from the given
+        primary; ``primary`` absent/``"NO ONE"`` promotes instead.
+        Epoch-stamped like Promote."""
+        from tpubloom.ha import promotion
+
+        primary = req.get("primary")
+        if primary is None or (
+            isinstance(primary, str)
+            and primary.strip().upper() in ("", "NO ONE")
+        ):
+            return promotion.promote_to_primary(
+                self,
+                repl_log_dir=req.get("repl_log_dir"),
+                epoch=req.get("epoch"),
+            )
+        return promotion.become_replica(self, primary, epoch=req.get("epoch"))
+
     # -- replication: op log, apply, snapshots (ISSUE 3) ---------------------
 
     def _log_op(
@@ -295,12 +427,15 @@ class BloomService:
         may_truncate: bool = True,
     ) -> None:
         """Append one committed mutating op to the op log (no-op without
-        a log, and during replay). MUST be called while still holding the
-        lock the op committed under — log order is apply order.
-        ``may_truncate=False`` for callers holding ``self._lock``
-        (Create/Drop): the truncation sweep re-takes it and the lock is
-        not re-entrant — their sweep runs on a later data-plane append."""
-        if self.oplog is None or self._replaying:
+        a log, during replay, and on replicas — a chained replica's log
+        is fed by :meth:`reappend_record`, which preserves the upstream
+        seq space; handler-side appends would mint conflicting seqs).
+        MUST be called while still holding the lock the op committed
+        under — log order is apply order. ``may_truncate=False`` for
+        callers holding ``self._lock`` (Create/Drop): the truncation
+        sweep re-takes it and the lock is not re-entrant — their sweep
+        runs on a later data-plane append."""
+        if self.oplog is None or self._replaying or self._stream_fed:
             return
         try:
             seq = self.oplog.append(method, req, rid=obs.current_rid())
@@ -497,6 +632,9 @@ class BloomService:
         with self._lock:
             old = self._filters.pop(name, None)
             self._filters[name] = mf
+            # a replica with durable state (cursor-persistence satellite)
+            # must be able to restore this filter at restart too
+            self._manifest_put(name, self._manifest_req_for(name, filt))
         if old is not None and old.checkpointer:
             old.checkpointer.close(final_checkpoint=False)
         self.metrics.count("repl_snapshots_installed")
@@ -512,6 +650,7 @@ class BloomService:
             ]
             for n, _ in victims:
                 del self._filters[n]
+                self._manifest_remove(n)
         for n, mf in victims:
             if mf.checkpointer:
                 mf.checkpointer.close(final_checkpoint=False)
@@ -571,9 +710,17 @@ class BloomService:
             "in_flight": in_flight,
             "max_in_flight": self.max_in_flight,
             "role": "replica" if self.read_only else "primary",
+            "epoch": self.epoch,
         }
-        if self.replica_applier is not None:
+        if self.listen_address:
+            resp["listen"] = self.listen_address
+        if self.replica_applier is not None and self.read_only:
             resp["replication"] = self.replica_applier.status()
+            if self.oplog is not None:  # chained: serves downstream too
+                resp["replication"]["log"] = self.oplog.stats()
+                resp["replication"]["replicas"] = (
+                    self.repl_sessions.describe()
+                )
         elif self.oplog is not None:
             resp["replication"] = {
                 "log": self.oplog.stats(),
@@ -793,11 +940,48 @@ class BloomService:
     # FIRST, then drives the record tail over that.
 
     def _manifest_path(self) -> Optional[str]:
-        if self.oplog is None:
+        if self._manifest_dir is None:
             return None
         import os
 
-        return os.path.join(self.oplog.directory, "manifest.json")
+        return os.path.join(self._manifest_dir, "manifest.json")
+
+    @staticmethod
+    def _manifest_req_for(name: str, filt) -> dict:
+        """Reconstruct a CreateFilter request from a LIVE filter — for
+        manifest entries with no original request at hand (snapshot-
+        installed filters on replicas, manifest rebuild at promotion)."""
+        if hasattr(filt, "layers"):  # scalable
+            base = filt.base_config.to_dict()
+            opts = {
+                k: v for k, v in base.items() if k not in ("m", "k", "key_name")
+            }
+            return {
+                "name": name,
+                "capacity": filt.capacity,
+                "error_rate": filt.error_rate,
+                "options": opts,
+                "scalable": {
+                    "growth": filt.growth,
+                    "tightening": filt.tightening,
+                },
+            }
+        return {"name": name, "config": filt.config.to_dict()}
+
+    def rebuild_manifest(self) -> None:
+        """Rewrite the creation manifest from the live filter set — a
+        promotion that opened a FRESH log dir must seed it with the
+        filters the replica already holds, or a later restart's replay
+        would not know to restore them."""
+
+        def mutate(manifest: dict) -> None:
+            manifest.clear()
+            with self._lock:
+                items = list(self._filters.items())
+            for name, mf in items:
+                manifest[name] = self._manifest_req_for(name, mf.filter)
+
+        self._manifest_write(mutate)
 
     def _manifest_put(self, name: str, create_req: dict) -> None:
         self._manifest_write(lambda m: m.__setitem__(name, create_req))
@@ -1197,6 +1381,25 @@ def _wrap(service: BloomService, method_name: str):
                     rctx.summary = summarize_request(method_name, req)
                     name = req.get("name")
                     req_name = name if isinstance(name, str) else None
+                    # topology-epoch fence (ISSUE 4): a mutating request
+                    # stamped with an OLDER epoch than this server's was
+                    # routed under a pre-failover view — reject so the
+                    # client refreshes its topology instead of writing
+                    # under a stale map
+                    req_epoch = req.get("epoch")
+                    if (
+                        req_epoch is not None
+                        and method_name in protocol.MUTATING_METHODS
+                        and int(req_epoch) < service.epoch
+                    ):
+                        service.metrics.count("stale_epoch_rejected")
+                        raise protocol.BloomServiceError(
+                            "STALE_EPOCH",
+                            f"request epoch {req_epoch} predates the "
+                            f"current topology epoch {service.epoch} — "
+                            f"refresh your topology",
+                            details={"epoch": service.epoch},
+                        )
                     resp = handler(req)
                     # post-apply fault: the handler's effect landed but the
                     # response is "lost" — the case rid-dedup must absorb
@@ -1343,12 +1546,54 @@ def _inspect_quarantine_main(argv: list) -> int:
     return 0
 
 
+def _promote_main(argv: list) -> int:
+    """``python -m tpubloom.server promote <address> [--epoch N]
+    [--repl-log-dir DIR]`` — manual replica→primary promotion (Redis
+    ``REPLICAOF NO ONE`` parity): sends the ``Promote`` RPC to the
+    given replica. ``--repl-log-dir`` names the log dir the REMOTE
+    process should open when it was started without one (chained
+    replicas already have theirs)."""
+    import argparse
+    import json as _json
+
+    from tpubloom.server.client import BloomClient
+
+    parser = argparse.ArgumentParser(
+        prog="tpubloom.server promote",
+        description="promote a running replica to primary",
+    )
+    parser.add_argument("address", help="host:port of the replica")
+    parser.add_argument(
+        "--epoch", type=int, default=None,
+        help="pin the topology epoch (default: the replica bumps its own)",
+    )
+    parser.add_argument(
+        "--repl-log-dir", default=None,
+        help="op-log dir the replica should adopt when it has none",
+    )
+    args = parser.parse_args(argv)
+    req: dict = {}
+    if args.epoch is not None:
+        req["epoch"] = args.epoch
+    if args.repl_log_dir:
+        req["repl_log_dir"] = args.repl_log_dir
+    with BloomClient(args.address) as client:
+        resp = client._rpc("Promote", req)
+    print(_json.dumps(resp))
+    return 0
+
+
 def main(argv: Optional[list] = None) -> None:
     """``python -m tpubloom.server [port] [checkpoint_dir]
     [--metrics-port N] [--slowlog-capacity N] [--max-in-flight N]
-    [--drain-grace S] [--repl-log-dir DIR] [--replica-of HOST:PORT]``
+    [--drain-grace S] [--repl-log-dir DIR] [--replica-of HOST:PORT]
+    [--repl-batch-bytes N] [--announce HOST:PORT]``
 
-    Subcommand: ``python -m tpubloom.server inspect-quarantine <dir>``.
+    ``--replica-of`` + ``--repl-log-dir`` together run a CHAINED replica
+    (ISSUE 4): applied records re-append to the local log, ``ReplStream``
+    serves downstream replicas, and promotion is cheap.
+
+    Subcommands: ``inspect-quarantine <dir>``, ``promote <address>``.
     """
     import argparse
     import signal
@@ -1357,6 +1602,8 @@ def main(argv: Optional[list] = None) -> None:
     argv = list(_sys.argv[1:]) if argv is None else list(argv)
     if argv and argv[0] == "inspect-quarantine":
         raise SystemExit(_inspect_quarantine_main(argv[1:]))
+    if argv and argv[0] == "promote":
+        raise SystemExit(_promote_main(argv[1:]))
 
     parser = argparse.ArgumentParser(
         prog="tpubloom.server", description="tpubloom gRPC server"
@@ -1409,14 +1656,27 @@ def main(argv: Optional[list] = None) -> None:
         default=None,
         metavar="HOST:PORT",
         help="run as a read-only replica of the given primary: stream and "
-        "apply its op log, serve reads, answer writes with READONLY",
+        "apply its op log, serve reads, answer writes with READONLY. "
+        "Combine with --repl-log-dir for a CHAINED replica (re-appends "
+        "applied records locally, serves ReplStream downstream, promotes "
+        "cheaply)",
+    )
+    parser.add_argument(
+        "--repl-batch-bytes",
+        type=int,
+        default=None,
+        help="coalesce ReplStream records into zlib-compressed frames of "
+        "up to N raw bytes for replicas that negotiated the capability "
+        "(WAN links; default: one record per message)",
+    )
+    parser.add_argument(
+        "--announce",
+        default=None,
+        metavar="HOST:PORT",
+        help="address to announce to primaries/sentinels (Redis "
+        "replica-announce parity; default 127.0.0.1:<port>)",
     )
     args = parser.parse_args(argv)
-    if args.replica_of and args.repl_log_dir:
-        parser.error(
-            "--replica-of and --repl-log-dir are mutually exclusive "
-            "(chained replication is not supported yet)"
-        )
     ckpt_dir = args.checkpoint_dir
     sink_factory = (
         (lambda config: ckpt.FileSink(ckpt_dir)) if ckpt_dir else (lambda config: None)
@@ -1430,12 +1690,15 @@ def main(argv: Optional[list] = None) -> None:
         from tpubloom.repl import OpLog
 
         oplog = OpLog(args.repl_log_dir, fsync=args.repl_fsync)
+    announce = args.announce or f"127.0.0.1:{args.port}"
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
         max_in_flight=args.max_in_flight,
         oplog=oplog,
         read_only=bool(args.replica_of),
+        repl_batch_bytes=args.repl_batch_bytes,
+        listen_address=announce,
     )
     if oplog is not None:
         stats = service.replay_oplog()
@@ -1447,10 +1710,35 @@ def main(argv: Optional[list] = None) -> None:
         )
     applier = None
     if args.replica_of:
-        from tpubloom.repl import ReplicaApplier
+        from tpubloom.repl import (
+            ReplicaApplier,
+            ReplicaStateStore,
+            bootstrap_from_local,
+        )
 
-        applier = ReplicaApplier(service, args.replica_of).start()
-        log.info("replicating from %s (read-only)", args.replica_of)
+        # replica durability (ISSUE 4 satellite): the cursor + manifest
+        # live beside the op log (chained) or the checkpoint sink — a
+        # restart partial-resyncs instead of always paying a full resync
+        state_dir = args.repl_log_dir or ckpt_dir
+        store = ReplicaStateStore(state_dir) if state_dir else None
+        service.replica_state_store = store
+        if service._manifest_dir is None and state_dir:
+            service._manifest_dir = state_dir
+        cursor, log_id = bootstrap_from_local(service, store)
+        applier = ReplicaApplier(
+            service,
+            args.replica_of,
+            state_store=store,
+            listen_address=announce,
+            initial_cursor=cursor,
+            initial_log_id=log_id,
+        ).start()
+        log.info(
+            "replicating from %s (read-only%s%s)",
+            args.replica_of,
+            ", chained" if oplog is not None else "",
+            f", resuming at seq {cursor}" if cursor is not None else "",
+        )
     server, bound = build_server(service, f"0.0.0.0:{args.port}")
     server.start()
     log.info("tpubloom server listening on :%d (checkpoints: %s)", bound, ckpt_dir)
@@ -1482,11 +1770,16 @@ def main(argv: Optional[list] = None) -> None:
     # a roll, not an outage.
     time.sleep(min(2.0, args.drain_grace / 3))
     server.stop(grace=args.drain_grace).wait()
-    if applier is not None:
-        applier.stop()
+    # a runtime Promote/ReplicaOf may have replaced (or dropped) the
+    # startup applier and op log — drain whatever is CURRENT
+    live_applier = service.replica_applier or applier
+    if live_applier is not None:
+        live_applier.stop()
     log.info("drain: final checkpoints...")
     service.shutdown()
-    if oplog is not None:
+    if service.oplog is not None:
+        service.oplog.close()
+    elif oplog is not None:
         oplog.close()
     if metrics_server is not None:
         metrics_server.close()

@@ -30,7 +30,7 @@ class Redis
         SERVICE = "tpubloom.BloomService".freeze
         METHODS = %w[
           Health CreateFilter DropFilter ListFilters
-          InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint
+          InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint Wait
         ].freeze
 
         IDENTITY = proc { |bytes| bytes }
@@ -82,12 +82,24 @@ class Redis
         #                    restart the driver transparently re-creates the
         #                    filter (the server restores its newest
         #                    checkpoint) and retries once.
+        #   :min_replicas  - default durability quorum stamped on every
+        #                    mutating call (Redis min-replicas-to-write
+        #                    parity, ISSUE 5): the server blocks the call
+        #                    after its op-log append until that many
+        #                    replicas acknowledged the record; a timeout
+        #                    raises ServiceError NOT_ENOUGH_REPLICAS (the
+        #                    write applied and is logged — only the quorum
+        #                    ack is missing). Per-call overrides via the
+        #                    min_replicas: kwarg; #wait is the WAIT-parity
+        #                    after-the-fact probe.
         def initialize(opts = {})
           @opts = opts
           @name = opts[:key_name] || "tpubloom"
           @max_retries = opts[:max_retries] || 5
           @sentinels = Array(opts[:sentinels])
           @epoch = nil
+          @min_replicas = opts[:min_replicas]
+          @last_write_seq = nil
           address = opts[:address] || "127.0.0.1:50051"
           if !@sentinels.empty? && (topo = fetch_topology)
             address = topo["primary"] || address
@@ -97,12 +109,17 @@ class Redis
           create_filter
         end
 
-        def insert(key)
-          insert_batch([key])
+        def insert(key, min_replicas: nil)
+          insert_batch([key], min_replicas: min_replicas)
         end
 
-        def insert_batch(keys)
-          rpc("InsertBatch", "name" => @name, "keys" => keys.map(&:to_s))
+        def insert_batch(keys, min_replicas: nil)
+          rpc(
+            "InsertBatch",
+            durability(
+              { "name" => @name, "keys" => keys.map(&:to_s) }, min_replicas
+            )
+          )
           true
         end
 
@@ -111,11 +128,13 @@ class Redis
         # (the :lua driver's add-script semantics, batched). Never
         # auto-retried: a replay after a landed insert would report the
         # batch's own keys as duplicates.
-        def insert_batch_was_present?(keys)
+        def insert_batch_was_present?(keys, min_replicas: nil)
           resp = rpc(
             "InsertBatch",
-            { "name" => @name, "keys" => keys.map(&:to_s),
-              "return_presence" => true },
+            durability(
+              { "name" => @name, "keys" => keys.map(&:to_s),
+                "return_presence" => true }, min_replicas
+            ),
             no_retry: true
           )
           unpack_bits(resp["presence"], resp["n"])
@@ -131,14 +150,27 @@ class Redis
           unpack_bits(resp["hits"], resp["n"])
         end
 
-        def delete(key)
-          rpc("DeleteBatch", "name" => @name, "keys" => [key.to_s])
+        def delete(key, min_replicas: nil)
+          rpc(
+            "DeleteBatch",
+            durability({ "name" => @name, "keys" => [key.to_s] }, min_replicas)
+          )
           true
         end
 
-        def clear
-          rpc("Clear", "name" => @name)
+        def clear(min_replicas: nil)
+          rpc("Clear", durability({ "name" => @name }, min_replicas))
           true
+        end
+
+        # Redis WAIT parity (ISSUE 5): block until numreplicas replicas
+        # acknowledged this driver's last write, up to timeout_ms; returns
+        # the count actually acked — possibly fewer (WAIT reports, it does
+        # not raise).
+        def wait(numreplicas, timeout_ms = 1000)
+          req = { "numreplicas" => numreplicas, "timeout_ms" => timeout_ms }
+          req["seq"] = @last_write_seq if @last_write_seq
+          rpc("Wait", req)["nreplicas"]
         end
 
         def stats
@@ -203,12 +235,23 @@ class Redis
             options["counting"] = true if @opts[:counting]
             req["options"] = options
           end
-          rpc("CreateFilter", req)
+          # the constructor default covers the boot-time create too — a
+          # fresh filter's existence is a write worth the quorum (the
+          # server skips the barrier when this is a no-op attach)
+          rpc("CreateFilter", durability(req, nil))
         end
 
         def counting?
           !!(@opts[:counting] || (@opts[:config] || {})["counting"] ||
              (@opts[:config] || {})[:counting])
+        end
+
+        # Per-call quorum wins over the constructor default; nil leaves the
+        # server's --min-replicas-to-write in charge.
+        def durability(payload, min_replicas)
+          quorum = min_replicas || @min_replicas
+          payload["min_replicas"] = quorum if quorum
+          payload
         end
 
         MUTATING = %w[CreateFilter DropFilter InsertBatch DeleteBatch
@@ -230,7 +273,10 @@ class Redis
             # stamp the cached topology epoch on writes: a server under a
             # newer topology answers STALE_EPOCH and we refresh
             payload["epoch"] = @epoch if @epoch && MUTATING.include?(method)
-            rpc_once(method, payload)
+            resp = rpc_once(method, payload)
+            # track the op-log seq of our newest write — what #wait gates on
+            @last_write_seq = resp["repl_seq"] if resp["repl_seq"]
+            resp
           rescue GRPC::Unavailable
             # mid-failover the old primary is unreachable: re-resolve the
             # topology; a changed primary resets the budget once (the rid

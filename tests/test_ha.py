@@ -679,6 +679,48 @@ def test_sentinel_without_quorum_never_fails_over(tmp_path):
         poplog.close()
 
 
+def test_sentinel_state_persists_across_restart(tmp_path):
+    """ISSUE-5 satellite (closes the PR-4 follow-up): with --state-dir,
+    a sentinel restart remembers the failover history — the adopted
+    topology epoch/primary AND the one-vote-per-epoch discipline — so a
+    full-quorum restart cannot re-grant spent epochs or resume watching
+    the pre-failover primary."""
+    state = str(tmp_path / "sentinel-state")
+    s = Sentinel("127.0.0.1:1", peers=[], quorum=2, state_dir=state)
+    # a completed failover announced by a peer leader
+    s.handle_AnnounceTopology(
+        {"epoch": 7, "primary": "127.0.0.1:9", "replicas": ["127.0.0.1:8"],
+         "fenced": "127.0.0.1:1"}
+    )
+    # and a vote granted in a later election
+    s._sdown = True
+    assert s.handle_VoteDown({"epoch": 8, "primary": "127.0.0.1:9"})["granted"]
+
+    # "restart": a fresh Sentinel over the same state dir
+    s2 = Sentinel("127.0.0.1:1", peers=[], quorum=2, state_dir=state)
+    topo = s2.handle_Topology({})
+    assert topo["epoch"] == 7 and topo["primary"] == "127.0.0.1:9"
+    assert "127.0.0.1:8" in topo["replicas"]
+    # the fenced-primary watchlist survives too — a stale primary that
+    # reappears AFTER the restart must still get demoted on sight
+    assert "127.0.0.1:1" in s2._fence_watch
+    # the spent vote survives: epoch 8 cannot be granted twice...
+    s2._sdown = True
+    assert not s2.handle_VoteDown(
+        {"epoch": 8, "primary": "127.0.0.1:9"}
+    )["granted"]
+    # ...but a genuinely newer epoch can
+    assert s2.handle_VoteDown({"epoch": 9, "primary": "127.0.0.1:9"})["granted"]
+
+    # corruption reads as absent — fall back to --watch, never crash
+    store_path = s2._state_store.path
+    with open(store_path, "a") as f:
+        f.write("rot")
+    s3 = Sentinel("127.0.0.1:1", peers=[], quorum=2, state_dir=state)
+    assert s3.handle_Topology({})["primary"] == "127.0.0.1:1"
+    assert s3._last_vote_epoch == 0
+
+
 def test_sentinel_vote_rules():
     s = Sentinel("127.0.0.1:1", peers=[], quorum=2)
     # not sdown -> no grant

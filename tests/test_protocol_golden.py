@@ -63,7 +63,16 @@ GOLDEN = {
     # idempotent no-ops on a primary — safe to replay raw
     "Promote": ("Promote", "80"),
     "ReplicaOf": ("ReplicaOf", "81a77072696d617279a64e4f204f4e45"),
+    # durability probe (ISSUE 5): numreplicas=0 answers immediately with
+    # the achieved count — safe to replay raw on any primary
+    "Wait": ("Wait", "82ab6e756d7265706c6963617300aa74696d656f75745f6d7332"),
 }
+
+#: one ``ReplAck`` client-streaming frame (ISSUE 5) — the exact bytes a
+#: replica's ack sender ships: session id from the sync frame + the
+#: newest fully-applied op seq
+GOLDEN_ACK_FRAME = "82a373696400a373657107"
+GOLDEN_ACK_FRAME_DICT = {"sid": 0, "seq": 7}
 
 #: the dict each fixture encodes (the pin below keeps python<->ruby
 #: encodings provably in sync; regenerate hex from these on change)
@@ -91,6 +100,7 @@ GOLDEN_DICTS = {
     "SlowlogReset": {},
     "Promote": {},
     "ReplicaOf": {"primary": "NO ONE"},
+    "Wait": {"numreplicas": 0, "timeout_ms": 50},
 }
 
 
@@ -110,17 +120,26 @@ def test_golden_bytes_match_ruby_encoding():
         assert msgpack.packb(
             GOLDEN_DICTS[name], use_bin_type=True
         ).hex() == hexbytes, f"fixture {name} drifted"
+    assert msgpack.packb(
+        GOLDEN_ACK_FRAME_DICT, use_bin_type=True
+    ).hex() == GOLDEN_ACK_FRAME, "ReplAck frame fixture drifted"
 
 
 @pytest.fixture()
-def raw_server(tmp_path):
+def raw_service_server(tmp_path):
     service = BloomService(sink_factory=lambda config: ckpt.FileSink(str(tmp_path)))
     srv, port = build_server(service, "127.0.0.1:0")
     srv.start()
     channel = grpc.insecure_channel(f"127.0.0.1:{port}")
-    yield channel
+    yield channel, service
     channel.close()
     srv.stop(grace=None)
+
+
+@pytest.fixture()
+def raw_server(raw_service_server):
+    channel, _ = raw_service_server
+    return channel
 
 
 def _call(channel, method, hexbytes):
@@ -187,6 +206,11 @@ def test_golden_replay_against_live_server(raw_server):
     r = _call(ch, *GOLDEN["ReplicaOf"])
     assert r["ok"] and r["already_primary"]
 
+    # Wait (ISSUE 5): numreplicas=0 reports the achieved count (0 here —
+    # no replicas) without blocking; the Ruby driver reads ok/nreplicas
+    r = _call(ch, *GOLDEN["Wait"])
+    assert r["ok"] and r["nreplicas"] == 0 and isinstance(r["seq"], int)
+
     r = _call(ch, *GOLDEN["SlowlogGet"])
     assert r["ok"] and len(r["entries"]) > 0
     e = r["entries"][0]
@@ -207,3 +231,36 @@ def test_golden_replay_against_live_server(raw_server):
     r = msgpack.unpackb(fn(bad), raw=False)
     assert r["ok"] is False and r["error"]["code"] == "NOT_FOUND"
     assert isinstance(r["error"]["message"], str)
+
+
+def test_golden_ack_frame_replay(raw_service_server):
+    """The ReplAck client-streaming frame a replica's ack sender ships,
+    replayed RAW: the committed bytes must land on the session's acked
+    cursor, and a Wait gated on that seq must count the replica."""
+    channel, service = raw_service_server
+    sid = service.repl_sessions.register("golden-peer", listen="127.0.0.1:9")
+    assert sid == 0, "fresh registry must hand out sid 0 (the frame pins it)"
+    fn = channel.stream_unary(
+        protocol.method_path("ReplAck"),
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    resp = msgpack.unpackb(
+        fn(iter([bytes.fromhex(GOLDEN_ACK_FRAME)])), raw=False
+    )
+    assert resp["ok"] and resp["frames"] == 1
+    (sess,) = service.repl_sessions.describe()
+    assert sess["acked"] == GOLDEN_ACK_FRAME_DICT["seq"]
+    # the ack is immediately visible to the durability gate
+    wait_req = msgpack.packb(
+        {"numreplicas": 1, "timeout_ms": 500,
+         "seq": GOLDEN_ACK_FRAME_DICT["seq"]},
+        use_bin_type=True,
+    )
+    wfn = channel.unary_unary(
+        protocol.method_path("Wait"),
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    r = msgpack.unpackb(wfn(wait_req), raw=False)
+    assert r["ok"] and r["nreplicas"] == 1

@@ -23,6 +23,11 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
   record's handler runs
 * ``repl.reappend``     — on a chained replica, before an applied record
   re-appends to the local op log (ISSUE 4)
+* ``repl.ack``          — replica side, before an ack frame ships on the
+  ``ReplAck`` stream; a firing DROPS that frame (ack loss in flight —
+  the periodic re-ack heals it once disarmed) (ISSUE 5)
+* ``repl.ack_recv``     — primary side, per ack frame received; a firing
+  kills the ack stream (the replica re-opens it on its next heartbeat)
 * ``ha.promote``        — at the top of replica→primary promotion
 * ``ha.vote``           — in the sentinel vote-request/grant path
 * ``shard.insert`` / ``shard.query`` / ``shard.delete`` — per-shard
@@ -87,6 +92,8 @@ KNOWN_POINTS = {
     "repl.stream_send",
     "repl.apply",
     "repl.reappend",
+    "repl.ack",
+    "repl.ack_recv",
     "ha.promote",
     "ha.vote",
     "shard.insert",

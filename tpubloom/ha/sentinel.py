@@ -27,7 +27,17 @@ thread:
   ``Health.replication.replicas[].listen`` announcements (Redis
   ``INFO replication`` parity); clients ask any sentinel ``Topology``
   for the current epoch/primary/replicas (``SENTINEL
-  get-master-addr-by-name`` parity).
+  get-master-addr-by-name`` parity);
+* **state persistence** (ISSUE 5 satellite) — with ``--state-dir`` the
+  current topology (epoch/primary/replicas) and the newest epoch this
+  sentinel has VOTED in persist to a CRC-checked
+  ``sentinel_state.json`` (:mod:`tpubloom.utils.crcjson`). A
+  full-quorum sentinel restart therefore does not forget failover
+  history: it resumes watching the post-failover primary at the
+  current epoch and keeps the one-vote-per-epoch discipline across the
+  restart (Redis Sentinel's config-epoch persistence). Corruption
+  reads as absent — the sentinel falls back to ``--watch`` and
+  re-learns epochs from the primaries' Health answers, never crashes.
 
 Fault point ``ha.vote`` fires in both the vote-request and vote-grant
 paths, so the chaos suite can kill a failover mid-election.
@@ -48,8 +58,58 @@ from tpubloom import faults
 from tpubloom.ha.topology import Topology
 from tpubloom.obs import counters as _counters
 from tpubloom.server import protocol
+from tpubloom.utils import crcjson
 
 log = logging.getLogger("tpubloom.sentinel")
+
+
+class SentinelStateStore:
+    """Persisted sentinel memory (ISSUE 5 satellite — Redis Sentinel
+    config-epoch parity): the adopted topology and the newest epoch this
+    sentinel has voted in, CRC-checked so a torn write reads as "no
+    state" (→ re-learn from the primaries, the safe direction)."""
+
+    STATE_FILE = "sentinel_state.json"
+    _FIELDS = ("epoch", "last_vote_epoch", "primary", "replicas", "fenced")
+
+    def __init__(self, directory: str):
+        import os
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, self.STATE_FILE)
+
+    def load(self):
+        data = crcjson.load(self.path, self._FIELDS)
+        if data is None:
+            return None
+        try:
+            return {
+                "epoch": int(data["epoch"]),
+                "last_vote_epoch": int(data["last_vote_epoch"]),
+                "primary": data["primary"],
+                "replicas": list(data["replicas"] or ()),
+                "fenced": list(data["fenced"] or ()),
+            }
+        except (ValueError, TypeError):
+            return None
+
+    def store(
+        self, epoch: int, last_vote_epoch: int, primary, replicas, fenced
+    ) -> None:
+        crcjson.store(
+            self.path,
+            {
+                "epoch": int(epoch),
+                "last_vote_epoch": int(last_vote_epoch),
+                "primary": primary,
+                "replicas": list(replicas or ()),
+                # the demoted-primary watchlist is failover memory too:
+                # forget it across a full-quorum restart and a stale
+                # primary that comes back is never fenced
+                "fenced": sorted(fenced or ()),
+            },
+        )
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_receive_message_length", 64 * 1024 * 1024),
@@ -72,6 +132,7 @@ class Sentinel:
         promote_timeout_s: Optional[float] = None,
         failover_cooldown_s: float = 2.0,
         sentinel_id: Optional[str] = None,
+        state_dir: Optional[str] = None,
     ):
         import secrets
 
@@ -99,6 +160,32 @@ class Sentinel:
         #: newest epoch this sentinel has VOTED in (self-votes included):
         #: one vote per epoch is the whole split-brain argument
         self._last_vote_epoch = 0
+        #: demoted-primary watchlist: addresses to fence if they come
+        #: back claiming a stale primaryship
+        self._fence_watch: set = set()
+        #: persisted failover memory (ISSUE 5 satellite): restart with
+        #: the post-failover topology + vote discipline instead of the
+        #: stale --watch view
+        self._state_store = (
+            SentinelStateStore(state_dir) if state_dir else None
+        )
+        if self._state_store is not None:
+            saved = self._state_store.load()
+            if saved is not None:
+                self._last_vote_epoch = saved["last_vote_epoch"]
+                self._fence_watch.update(saved["fenced"])
+                if saved["epoch"] > 0 and saved["primary"]:
+                    self.topology = Topology(
+                        epoch=saved["epoch"],
+                        primary=saved["primary"],
+                        replicas=saved["replicas"],
+                    )
+                    log.info(
+                        "sentinel state restored: epoch %d, primary %s "
+                        "(voted through epoch %d)",
+                        saved["epoch"], saved["primary"],
+                        self._last_vote_epoch,
+                    )
         self._sdown = False
         self._first_fail: Optional[float] = None
         self._last_failover_attempt = 0.0
@@ -112,9 +199,6 @@ class Sentinel:
 
         self._rand = _random.Random()
         self._election_stagger = self._rand.uniform(0, failover_cooldown_s)
-        #: demoted-primary watchlist: addresses to fence if they come
-        #: back claiming a stale primaryship
-        self._fence_watch: set = set()
         self.failovers = 0
         self._stop = threading.Event()
         self._channels: dict = {}
@@ -160,6 +244,23 @@ class Sentinel:
         for ch in self._channels.values():
             ch.close()
         self._channels.clear()
+
+    def _persist_state(self) -> None:
+        """Write the failover memory through the state store (no-op
+        without ``--state-dir``). Callers hold ``self._lock`` — the
+        write must capture exactly the view they just committed."""
+        if self._state_store is None:
+            return
+        try:
+            self._state_store.store(
+                self.topology.epoch,
+                self._last_vote_epoch,
+                self.topology.primary,
+                self.topology.replicas,
+                self._fence_watch,
+            )
+        except OSError:
+            log.exception("sentinel state persist failed (non-fatal)")
 
     # -- RPC plumbing --------------------------------------------------------
 
@@ -247,6 +348,10 @@ class Sentinel:
                 self._last_vote_epoch = epoch
                 self._granted_at = time.monotonic()
                 _counters.incr("sentinel_votes_granted")
+                # the vote is a PROMISE (one per epoch) — it must
+                # survive a restart or a rebooted sentinel could hand
+                # the same epoch to a second candidate
+                self._persist_state()
         return {
             "ok": True,
             "granted": granted,
@@ -265,6 +370,7 @@ class Sentinel:
                 old = req.get("fenced")
                 if old:
                     self._fence_watch.add(old)
+                self._persist_state()
                 log.info(
                     "adopted topology epoch %d (primary %s) from peer",
                     incoming.epoch, incoming.primary,
@@ -334,9 +440,10 @@ class Sentinel:
         self._sdown = False
         _counters.set_gauge("sentinel_sdown", 0.0)
         with self._lock:
-            self.topology.epoch = max(
-                self.topology.epoch, int(h.get("epoch") or 0)
-            )
+            node_epoch = int(h.get("epoch") or 0)
+            if node_epoch > self.topology.epoch:
+                self.topology.epoch = node_epoch
+                self._persist_state()
             if h.get("role") == "replica":
                 # the watched node was demoted behind our back (manual
                 # REPLICAOF / a failover we missed): follow its view
@@ -350,13 +457,18 @@ class Sentinel:
                     if primary not in self.topology.replicas:
                         self.topology.replicas.append(primary)
                     self.topology.primary = upstream
+                    self._persist_state()
                 return
             # discover announced replicas (INFO replication parity)
             sessions = (h.get("replication") or {}).get("replicas") or ()
             listens = [s.get("listen") for s in sessions if s.get("listen")]
+            discovered = False
             for addr in listens:
                 if addr not in self.topology.replicas:
                     self.topology.replicas.append(addr)
+                    discovered = True
+            if discovered:
+                self._persist_state()
             _counters.set_gauge(
                 "sentinel_known_replicas", len(self.topology.replicas)
             )
@@ -371,6 +483,7 @@ class Sentinel:
             if addr == primary:
                 with self._lock:
                     self._fence_watch.discard(addr)
+                    self._persist_state()
                 continue
             try:
                 h = self._node(addr, "Health", {})
@@ -400,6 +513,7 @@ class Sentinel:
                     and addr not in self.topology.replicas
                 ):
                     self.topology.replicas.append(addr)
+                self._persist_state()
 
     # -- failover ------------------------------------------------------------
 
@@ -431,6 +545,7 @@ class Sentinel:
                         self._sdown = False
                         self._first_fail = None
                         self._fence_watch.add(old_primary)
+                        self._persist_state()
                 log.info(
                     "adopted completed failover: %s is primary at epoch %d",
                     addr, incoming.epoch,
@@ -445,8 +560,10 @@ class Sentinel:
         with self._lock:
             new_epoch = max(self.topology.epoch, self._last_vote_epoch) + 1
             primary = self.topology.primary
-            # vote for ourselves (term discipline: once per epoch)
+            # vote for ourselves (term discipline: once per epoch) —
+            # persisted like any granted vote
             self._last_vote_epoch = new_epoch
+            self._persist_state()
         faults.fire("ha.vote")
         votes = 1
         for peer in self.peers:
@@ -559,6 +676,7 @@ class Sentinel:
                 self._sdown = False
                 self._first_fail = None
                 self._fence_watch.add(old_primary)
+                self._persist_state()
             self.failovers += 1
             _counters.incr("sentinel_failovers")
             log.warning(
@@ -582,6 +700,7 @@ class Sentinel:
                     )
                     with self._lock:
                         self._fence_watch.add(addr)
+                        self._persist_state()
             announce = {
                 **self.topology.to_dict(),
                 "fenced": old_primary,
@@ -629,6 +748,13 @@ def main(argv: Optional[list] = None) -> None:
         "--poll", type=float, default=0.25,
         help="health poll interval in seconds (default 0.25)",
     )
+    parser.add_argument(
+        "--state-dir", default=None,
+        help="persist failover memory (topology epoch + vote discipline) "
+        "to a CRC-checked sentinel_state.json in this directory, so a "
+        "restart resumes at the post-failover view (default: in-memory "
+        "only)",
+    )
     args = parser.parse_args(
         list(_sys.argv[1:]) if argv is None else list(argv)
     )
@@ -641,6 +767,7 @@ def main(argv: Optional[list] = None) -> None:
         quorum=args.quorum,
         poll_s=args.poll,
         down_after_s=args.down_after,
+        state_dir=args.state_dir,
     ).start()
     log.info("sentinel serving on :%d", sentinel.port)
     stop = threading.Event()

@@ -39,6 +39,14 @@ Metric catalog (all prefixed ``tpubloom_``):
   ``repl_records_streamed_total`` / ``repl_records_applied_total`` /
   ``repl_records_skipped_total`` / ``repl_reconnects_total`` /
   ``repl_log_torn_tail_truncated_total`` / ``monitor_events_dropped_total``.
+* synchronous replication (ISSUE 5): per-replica gauges
+  ``repl_acked_seq{replica}`` / ``repl_replica_cursor{replica}`` (from
+  the primary's connected sessions), the ``wait_blocked_current``
+  process gauge (commit-barrier + Wait waiters currently blocked), the
+  ``wait_barrier_seconds`` histogram (time spent blocked on replica
+  acks), and counters ``repl_acks_received_total`` /
+  ``repl_acks_sent_total`` / ``repl_acks_dropped_total`` /
+  ``quorum_writes_acked_total`` / ``quorum_write_failures_total``.
 """
 
 from __future__ import annotations
@@ -203,6 +211,15 @@ def render_service(service) -> str:
         bounds,
         "Per-RPC phase breakdown (decode/host_prep/h2d/kernel/d2h/encode)",
     )
+    waits = met.get("waits")
+    if waits and waits.get("n"):
+        _render_histogram(
+            out,
+            "wait_barrier_seconds",
+            [({}, waits)],
+            bounds,
+            "Time spent blocked on replica acks (commit barrier + Wait)",
+        )
 
     gauge_headers_done: set[str] = set()
 
@@ -232,6 +249,37 @@ def render_service(service) -> str:
             value = (snap.get("checkpoint") or {}).get(field)
             if isinstance(value, (int, float)):
                 gauge(suffix, help_text, value, labels)
+
+    # per-replica replication gauges (ISSUE 5): the primary's connected
+    # sessions, labeled by the replica's announced address. Deduped by
+    # label keeping the NEWEST session — a replica that reconnected
+    # before its old stream was reaped would otherwise emit the same
+    # series twice, and Prometheus rejects a scrape with duplicate
+    # samples wholesale
+    sessions = getattr(service, "repl_sessions", None)
+    if sessions is not None:
+        by_label: dict = {}
+        for sess in sessions.describe():
+            label = sess.get("listen") or sess.get("peer") or "?"
+            prev = by_label.get(label)
+            if prev is None or sess.get("connected_at", 0) >= prev.get(
+                "connected_at", 0
+            ):
+                by_label[label] = sess
+        for label, sess in sorted(by_label.items()):
+            labels = {"replica": label}
+            gauge(
+                "repl_acked_seq",
+                "Newest op seq this replica has acknowledged as applied",
+                sess.get("acked"),
+                labels,
+            )
+            gauge(
+                "repl_replica_cursor",
+                "Newest op seq streamed to this replica",
+                sess.get("cursor"),
+                labels,
+            )
 
     _header(out, "slowlog_entries", "gauge", "Entries currently in the slowlog")
     out.append(_line("slowlog_entries", len(service.slowlog)))

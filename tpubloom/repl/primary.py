@@ -23,9 +23,21 @@ The :class:`ReplicaSessions` hub tracks connected streams (gauge
 ``repl_max_replica_lag_seq`` and bound log truncation so a merely-slow
 replica is not forced into a full resync).
 
+Synchronous replication (ISSUE 5): the sync frames carry the session id
+(``sid``), and the replica opens a companion client-streaming
+``ReplAck`` RPC echoing it with every applied cursor
+(:func:`repl_ack`). :meth:`ReplicaSessions.ack` folds the frames into
+per-replica **acked** cursors, and :meth:`ReplicaSessions.wait_acked`
+is the blocking primitive behind both the ``Wait`` RPC (Redis ``WAIT``
+parity) and the ``min-replicas-to-write`` commit barrier — waiters
+count replicas whose acked seq is at or past a record's seq, with the
+currently-blocked count exported as the ``wait_blocked_current`` gauge
+and per-replica acked seqs as ``repl_acked_seq{replica}``.
+
 Fault point ``repl.stream_send`` fires before every snapshot/record
 send — the chaos suite kills a stream mid-batch with it and proves the
-reconnect replays nothing twice.
+reconnect replays nothing twice. ``repl.ack_recv`` fires per received
+ack frame (a firing kills the ack stream; the replica re-opens it).
 """
 
 from __future__ import annotations
@@ -54,23 +66,31 @@ CAP_BATCH_ZLIB = "batch-zlib"
 
 
 class ReplicaSessions:
-    """Connected-replica registry: addresses, cursors, lag gauges."""
+    """Connected-replica registry: addresses, cursors, acked seqs, lag
+    gauges, and the wait-for-quorum primitive (ISSUE 5)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
         self._ids = itertools.count()
         self._sessions: dict[int, dict] = {}
+        self._waiters = 0
 
     def register(self, peer: str, listen: str | None = None) -> int:
         """``listen`` is the replica's ANNOUNCED serving address (its
         gRPC listener, not the ephemeral peer port) — what sentinels
         discover replicas by, Redis ``replica-announce-ip/port`` parity."""
-        with self._lock:
+        with self._cond:
             sid = next(self._ids)
             self._sessions[sid] = {
+                "sid": sid,
                 "peer": peer,
                 "listen": listen,
                 "cursor": 0,
+                #: newest op seq the replica has ACKNOWLEDGED as applied
+                #: (via ReplAck) — what Wait/min-replicas block on; the
+                #: stream-side cursor only says what was SENT to it
+                "acked": 0,
+                "acked_at": 0.0,
                 "connected_at": time.time(),
             }
             n = len(self._sessions)
@@ -78,7 +98,7 @@ class ReplicaSessions:
         return sid
 
     def update(self, sid: int, cursor: int, head: int) -> None:
-        with self._lock:
+        with self._cond:
             sess = self._sessions.get(sid)
             if sess is not None:
                 sess["cursor"] = cursor
@@ -87,10 +107,83 @@ class ReplicaSessions:
             "repl_max_replica_lag_seq", max(lags) if lags else 0
         )
 
+    def ack(self, sid: int, seq: int) -> None:
+        """Fold one ReplAck frame in: the replica behind session ``sid``
+        has fully applied every record up to ``seq``. Monotone per
+        session (a late/reordered frame never rewinds), and every
+        advance wakes the quorum waiters."""
+        with self._cond:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                return  # stream already reconnected under a new sid
+            sess["acked_at"] = time.time()
+            if seq <= sess["acked"]:
+                return
+            sess["acked"] = seq
+            self._cond.notify_all()
+
+    def count(self) -> int:
+        with self._cond:
+            return len(self._sessions)
+
+    def count_acked(self, seq: int) -> int:
+        """Replicas whose acked cursor is at or past ``seq``."""
+        with self._cond:
+            return sum(1 for s in self._sessions.values() if s["acked"] >= seq)
+
+    def wait_acked(
+        self,
+        seq: int,
+        needed: int,
+        timeout: float,
+        *,
+        require_connected: int = 0,
+    ) -> int:
+        """Block until at least ``needed`` replicas have acked ``seq``
+        (or ``timeout`` elapses); returns the count actually acked —
+        Redis WAIT semantics, the caller decides whether falling short
+        is an error. ``needed <= 0`` returns the current count
+        immediately. Blocked waiters are the ``wait_blocked_current``
+        gauge.
+
+        ``require_connected`` is the commit barrier's mid-wait
+        attainability check: once fewer than that many replicas are even
+        CONNECTED the quorum cannot complete this round, so return the
+        current count immediately instead of sleeping out the timeout
+        (``unregister`` wakes waiters exactly for this). The Wait RPC
+        passes 0 — a replica may reconnect within its window, and Redis
+        WAIT rides out the full timeout."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            count = sum(1 for s in self._sessions.values() if s["acked"] >= seq)
+            if needed <= 0 or count >= needed:
+                return count
+            self._waiters += 1
+            _counters.set_gauge("wait_blocked_current", self._waiters)
+            try:
+                while True:
+                    count = sum(
+                        1 for s in self._sessions.values() if s["acked"] >= seq
+                    )
+                    remaining = deadline - time.monotonic()
+                    if (
+                        count >= needed
+                        or remaining <= 0
+                        or len(self._sessions) < require_connected
+                    ):
+                        return count
+                    self._cond.wait(remaining)
+            finally:
+                self._waiters -= 1
+                _counters.set_gauge("wait_blocked_current", self._waiters)
+
     def unregister(self, sid: int) -> None:
-        with self._lock:
+        with self._cond:
             self._sessions.pop(sid, None)
             n = len(self._sessions)
+            # a vanished replica can no longer ack: re-evaluate quorums
+            # now rather than at their timeout
+            self._cond.notify_all()
         _counters.set_gauge("repl_connected_replicas", n)
         if not n:
             _counters.set_gauge("repl_max_replica_lag_seq", 0)
@@ -99,13 +192,13 @@ class ReplicaSessions:
         """Slowest connected replica's cursor (None when no replicas) —
         log truncation stays behind it so live streams never lose their
         tail mid-flight."""
-        with self._lock:
+        with self._cond:
             if not self._sessions:
                 return None
             return min(s["cursor"] for s in self._sessions.values())
 
     def describe(self) -> list:
-        with self._lock:
+        with self._cond:
             return [dict(s) for s in self._sessions.values()]
 
 
@@ -202,6 +295,9 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                 "cursor": cursor,
                 "log_id": oplog.log_id,
                 "epoch": getattr(service, "epoch", 0),
+                # the replica echoes the session id on its ReplAck
+                # frames — how acks land on THIS session's acked cursor
+                "sid": sid,
             }
         else:
             _counters.incr("repl_partial_resyncs")
@@ -210,6 +306,7 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                 "cursor": cursor,
                 "log_id": oplog.log_id,
                 "epoch": getattr(service, "epoch", 0),
+                "sid": sid,
             }
         sessions.update(sid, cursor, oplog.last_seq)
         follower = oplog.follower(cursor)
@@ -247,3 +344,35 @@ def repl_stream(service, req: dict, context, *, heartbeat_s: float = DEFAULT_HEA
                 }
     finally:
         sessions.unregister(sid)
+
+
+def repl_ack(service, request_iterator, context):
+    """Behavior behind the client-streaming ``ReplAck`` RPC (ISSUE 5):
+    consume ``{"sid", "seq"}`` frames from one replica for the lifetime
+    of its ack stream, folding each into the matching session's acked
+    cursor. Returns the single response dict once the stream ends.
+
+    Fault point ``repl.ack_recv`` fires per frame; a firing propagates
+    out of the handler — gRPC fails the RPC, the replica notices the
+    dead ack stream at its next heartbeat and re-opens it (re-sending
+    its current cursor, so no ack is permanently lost)."""
+    from tpubloom.server import protocol
+
+    frames = 0
+    for raw in request_iterator:
+        faults.fire("repl.ack_recv")
+        try:
+            frame = protocol.decode(raw)
+        except Exception:
+            _counters.incr("repl_ack_decode_errors")
+            continue
+        sid, seq = frame.get("sid"), frame.get("seq")
+        if sid is None or seq is None:
+            continue
+        frames += 1
+        # counted per FRAME (idle re-acks included) so the pair
+        # sent-vs-received stays comparable: a growing gap means real
+        # ack loss, not the monotone-advance filter in ack()
+        _counters.incr("repl_acks_received")
+        service.repl_sessions.ack(int(sid), int(seq))
+    return {"ok": True, "frames": frames}

@@ -21,6 +21,12 @@ server read-only (writes get ``READONLY``, Redis parity) with one
 * **liveness** — transport errors back off exponentially
   (``repl_reconnects``); the link state lands in Health via
   :meth:`status` (``link: connected/connecting/lost``).
+* **acks** (ISSUE 5) — alongside the sync stream the applier keeps a
+  client-streaming ``ReplAck`` RPC open (:class:`_AckSender`), echoing
+  the session id from the sync frame with every applied cursor
+  (coalesced latest-wins + periodic re-ack). This is the upstream half
+  of the primary's ``WAIT`` / ``min-replicas-to-write`` durability
+  gate; fault point ``repl.ack`` drops individual frames (ack loss).
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ from typing import Optional
 import grpc
 import msgpack
 
+from tpubloom import faults
 from tpubloom.obs import counters as _counters
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
@@ -148,6 +155,77 @@ def bootstrap_from_local(service, state_store: Optional[ReplicaStateStore]):
     return cursor, saved["log_id"]
 
 
+class _AckSender:
+    """Replica→primary acknowledgement stream (ISSUE 5): feeds the
+    client-streaming ``ReplAck`` RPC with ``{"sid", "seq"}`` frames.
+
+    Coalescing is latest-wins: the applier calls :meth:`update` per
+    applied record, the generator ships whatever the newest cursor is
+    when gRPC drains it — a fast apply loop costs one frame per drain,
+    not one per record. An idle stream re-sends the current cursor
+    every ``reack_s`` seconds, which (a) keeps the primary's ack
+    freshness view live and (b) heals any frame lost in flight (the
+    ``repl.ack`` fault point drops frames exactly there, so a chaos run
+    recovers the moment it disarms).
+    """
+
+    def __init__(self, channel, sid: int, *, reack_s: float = 0.5):
+        self.sid = sid
+        self.reack_s = reack_s
+        self._cond = threading.Condition()
+        self._seq: Optional[int] = None
+        self._sent: Optional[int] = None
+        self._closed = False
+        multi = channel.stream_unary(
+            protocol.method_path("ReplAck"),
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self._future = multi.future(self._frames(), timeout=None)
+
+    @property
+    def broken(self) -> bool:
+        """True once the RPC ended (server killed the ack stream, e.g.
+        an injected ``repl.ack_recv``) — the applier re-opens it."""
+        return self._future.done() and not self._closed
+
+    def update(self, seq: int) -> None:
+        with self._cond:
+            if self._seq is None or seq > self._seq:
+                self._seq = seq
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._future.cancel()
+
+    def _frames(self):
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                if self._seq is None or self._seq == self._sent:
+                    self._cond.wait(self.reack_s)
+                if self._closed:
+                    return
+                seq = self._seq
+                if seq is None:
+                    continue
+                self._sent = seq
+            try:
+                # ack-loss injection: a firing drops THIS frame only —
+                # the seq stays marked sent, and the periodic re-ack
+                # path retries it after reack_s (heals once disarmed)
+                faults.fire("repl.ack")
+            except faults.InjectedFault:
+                _counters.incr("repl_acks_dropped")
+                continue
+            _counters.incr("repl_acks_sent")
+            yield protocol.encode({"sid": self.sid, "seq": seq})
+
+
 class ReplicaApplier:
     """Background thread that keeps a local (read-only) service in sync
     with a primary."""
@@ -194,6 +272,9 @@ class ReplicaApplier:
         self._stop = threading.Event()
         self._call = None
         self._call_lock = threading.Lock()
+        #: live ReplAck sender (sync-repl, ISSUE 5); rebuilt per sync
+        self._ack: Optional[_AckSender] = None
+        self._channel = None
         self._thread = threading.Thread(
             target=self._run, name="tpubloom-replica", daemon=True
         )
@@ -212,6 +293,9 @@ class ReplicaApplier:
         with self._call_lock:
             if self._call is not None:
                 self._call.cancel()
+            if self._ack is not None:
+                self._ack.close()
+                self._ack = None
         if self._thread.is_alive():
             self._thread.join(timeout=timeout)
         self._persist_cursor(force=True)
@@ -242,6 +326,7 @@ class ReplicaApplier:
             "partial_syncs": self.partial_syncs,
             "records_applied": self.records_applied,
             "records_skipped": self.records_skipped,
+            "sync_repl": self._ack is not None and not self._ack.broken,
         }
 
     def wait_caught_up(self, timeout: float = 30.0, poll: float = 0.02) -> bool:
@@ -283,6 +368,7 @@ class ReplicaApplier:
                     ("grpc.max_receive_message_length", 256 * 1024 * 1024),
                 ],
             )
+            self._channel = channel
             stream_call = channel.unary_stream(
                 protocol.method_path("ReplStream"),
                 request_serializer=lambda b: b,
@@ -322,7 +408,14 @@ class ReplicaApplier:
             finally:
                 with self._call_lock:
                     self._call = None
+                    # the ack stream rides this channel — tear it down
+                    # with the sync stream; the next sync re-opens it
+                    # under its fresh session id
+                    if self._ack is not None:
+                        self._ack.close()
+                        self._ack = None
                 channel.close()
+                self._channel = None
             if self._stop.is_set():
                 break
             self.link = "lost"
@@ -361,6 +454,7 @@ class ReplicaApplier:
             self._adopt_epoch(msg)
             self.link = "connected"
             self._persist_cursor(force=True)
+            self._start_ack(msg)
         elif kind == "partial_sync":
             self.last_sync_kind = "partial"
             self.partial_syncs += 1
@@ -369,6 +463,7 @@ class ReplicaApplier:
             self._adopt_epoch(msg)
             self.link = "connected"
             self._persist_cursor(force=True)
+            self._start_ack(msg)
         elif kind == "record":
             self._handle_record(msg)
         elif kind == "records":
@@ -384,6 +479,19 @@ class ReplicaApplier:
             self._adopt_epoch(msg)
             if self.cursor is not None and self.head_seq <= self.cursor:
                 _counters.set_gauge("repl_lag_seconds", 0.0)
+            with self._call_lock:
+                if self._ack is not None and self._ack.broken:
+                    # the primary (or an injected repl.ack_recv) killed
+                    # the ack stream: re-open it under the same session
+                    # and re-send the current cursor
+                    _counters.incr("repl_ack_stream_reopened")
+                    sid = self._ack.sid
+                    self._ack.close()
+                    self._ack = None
+                    if self._channel is not None:
+                        self._ack = _AckSender(self._channel, sid)
+                        if self.cursor is not None:
+                            self._ack.update(self.cursor)
         elif kind == "error":
             raise protocol.BloomServiceError(
                 msg.get("code", "UNKNOWN"), msg.get("message", "")
@@ -391,6 +499,24 @@ class ReplicaApplier:
         _counters.set_gauge(
             "repl_lag_seq", max(0, self.head_seq - (self.cursor or 0))
         )
+
+    def _start_ack(self, msg: dict) -> None:
+        """(Re)open the ReplAck stream for the session id the sync frame
+        announced; primaries predating sync-repl send no ``sid`` and get
+        no acks (they have no barrier to feed either)."""
+        sid = msg.get("sid")
+        with self._call_lock:
+            if self._ack is not None:
+                self._ack.close()
+                self._ack = None
+            if sid is None or self._channel is None:
+                return
+            self._ack = _AckSender(self._channel, int(sid))
+            if self.cursor is not None:
+                # the sync point itself is applied state — ack it now so
+                # a quorum blocked on pre-sync records unblocks without
+                # waiting for the next record
+                self._ack.update(self.cursor)
 
     def _adopt_epoch(self, msg: dict) -> None:
         """Sync/heartbeat frames carry the primary's topology epoch —
@@ -420,6 +546,9 @@ class ReplicaApplier:
             _counters.incr("repl_records_skipped")
         self.cursor = rec["seq"]
         self.head_seq = max(self.head_seq, rec["seq"])
+        ack = self._ack
+        if ack is not None:
+            ack.update(rec["seq"])
         self._persist_cursor()
         _counters.set_gauge(
             "repl_lag_seconds", max(0.0, time.time() - rec.get("ts", 0))

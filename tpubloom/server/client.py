@@ -52,6 +52,21 @@ Replication-awareness (ISSUE 3):
   double-applying. (Servers older than ISSUE 3 do not cache inserts —
   pin ``max_retries=0`` per call-site if you must talk to one.)
 
+Durability (ISSUE 5 — Redis ``WAIT`` / ``min-replicas-to-write``
+parity):
+
+* every mutating response carries the op-log ``repl_seq`` of its record
+  (tracked as ``self.last_write_seq``); :meth:`BloomClient.wait`
+  blocks until N replicas acknowledged it and returns the achieved
+  count (WAIT semantics — short counts report, they do not raise);
+* ``insert_batch`` / ``delete_batch`` / ``clear`` accept a per-call
+  ``min_replicas=`` (+ ``min_replicas_timeout_ms=``): the server blocks
+  the RPC after its op-log append until that many replicas acked the
+  record. A barrier that times out raises ``NOT_ENOUGH_REPLICAS`` —
+  deliberately NOT auto-retried (the write applied and is logged; the
+  caller decides whether to re-wait via :meth:`wait`, retry under the
+  same rid, or surface the degraded durability).
+
 Observability: every RPC is stamped with a generated request id
 (``self.last_rid`` after the call) which the server folds into its
 profiler spans and slowlog entries — ``slowlog_get()`` entries carry the
@@ -292,6 +307,9 @@ class BloomClient:
         self.read_preference = read_preference
         self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
         self.last_rid: Optional[str] = None
+        #: op-log seq of this client's newest acknowledged write — what
+        #: :meth:`wait` asks the durability quorum about (WAIT parity)
+        self.last_write_seq: Optional[int] = None
         self._creations: dict[str, dict] = {}
         self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._calls = self._make_calls(self._channel)
@@ -325,10 +343,31 @@ class BloomClient:
             for m in protocol.STREAM_METHODS
         }
 
-    def _call_once(self, method: str, req: dict, calls=None) -> dict:
+    def _call_once(
+        self, method: str, req: dict, calls=None, timeout: Optional[float] = None
+    ) -> dict:
         calls = self._calls if calls is None else calls
-        raw = calls[method](protocol.encode(req), timeout=self.timeout)
+        raw = calls[method](
+            protocol.encode(req),
+            timeout=self.timeout if timeout is None else timeout,
+        )
         return protocol.check(protocol.decode(raw))
+
+    def _call_timeout(self, method: str, req: dict) -> Optional[float]:
+        """Per-call gRPC deadline: a server legitimately blocking on a
+        replica quorum (commit barrier / Wait) for longer than
+        ``self.timeout`` must not be killed by the client first — the
+        deadline stretches to the requested wait plus margin. ``Wait``
+        with ``timeout_ms<=0`` means "server cap" (60s), so allow that
+        much."""
+        wait_ms = req.get("min_replicas_timeout_ms")
+        if method == "Wait":
+            wait_ms = req.get("timeout_ms")
+            if wait_ms is not None and int(wait_ms) <= 0:
+                wait_ms = 60_000  # the server's WAIT_TIMEOUT_CAP_S
+        if not wait_ms:
+            return None
+        return max(self.timeout, int(wait_ms) / 1000.0 + 5.0)
 
     def _try_replica(self, method: str, req: dict) -> Optional[dict]:
         """One replica attempt for a routed read; None = fall back to the
@@ -418,10 +457,13 @@ class BloomClient:
         stale_refreshed = False
         attempt = 0
         shed_attempt = 0
+        call_timeout = self._call_timeout(method, req)
         while True:
             try:
-                resp = self._call_once(method, req)
+                resp = self._call_once(method, req, timeout=call_timeout)
                 self.breaker.record_success()
+                if resp.get("repl_seq") is not None:
+                    self.last_write_seq = int(resp["repl_seq"])
                 return resp
             except grpc.RpcError as e:
                 if e.code() is grpc.StatusCode.UNAVAILABLE and self.sentinels:
@@ -641,18 +683,35 @@ class BloomClient:
     def _keys(keys: Sequence[bytes | str]) -> list:
         return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
 
+    @staticmethod
+    def _durability(req: dict, min_replicas, timeout_ms) -> dict:
+        """Fold the per-call durability override into a request (ISSUE
+        5): the server blocks the RPC until ``min_replicas`` replicas
+        acked its record (NOT_ENOUGH_REPLICAS on timeout)."""
+        if min_replicas is not None:
+            req["min_replicas"] = int(min_replicas)
+        if timeout_ms is not None:
+            req["min_replicas_timeout_ms"] = int(timeout_ms)
+        return req
+
     def insert_batch(
         self,
         name: str,
         keys: Sequence[bytes | str],
         *,
         return_presence: bool = False,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
     ):
         """Insert a batch; with ``return_presence`` also get each key's
         membership BEFORE the batch (fused test-and-insert server-side —
         the dedup primitive). Returns the insert count, or the presence
-        bool array when requested."""
-        req = {"name": name, "keys": self._keys(keys)}
+        bool array when requested. ``min_replicas`` demands a per-call
+        durability quorum stronger than the server default."""
+        req = self._durability(
+            {"name": name, "keys": self._keys(keys)},
+            min_replicas, min_replicas_timeout_ms,
+        )
         if not return_presence:
             return self._rpc("InsertBatch", req)["n"]
         req["return_presence"] = True
@@ -678,11 +737,22 @@ class BloomClient:
         resp = self._rpc("QueryBatch", {"name": name, "keys": self._keys(keys)})
         return self._unpack_bool(resp, "hits")
 
-    def delete_batch(self, name: str, keys: Sequence[bytes | str]) -> int:
+    def delete_batch(
+        self,
+        name: str,
+        keys: Sequence[bytes | str],
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> int:
         """Counting-filter delete. Auto-retried like any other op: retries
         reuse the call's rid and the server's dedup cache answers a replay
         whose first attempt already landed, so no double-decrement."""
-        return self._rpc("DeleteBatch", {"name": name, "keys": self._keys(keys)})["n"]
+        req = self._durability(
+            {"name": name, "keys": self._keys(keys)},
+            min_replicas, min_replicas_timeout_ms,
+        )
+        return self._rpc("DeleteBatch", req)["n"]
 
     def insert(self, name: str, key: bytes | str) -> None:
         self.insert_batch(name, [key])
@@ -690,8 +760,40 @@ class BloomClient:
     def include(self, name: str, key: bytes | str) -> bool:
         return bool(self.include_batch(name, [key])[0])
 
-    def clear(self, name: str) -> None:
-        self._rpc("Clear", {"name": name})
+    def clear(
+        self,
+        name: str,
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> None:
+        self._rpc(
+            "Clear",
+            self._durability(
+                {"name": name}, min_replicas, min_replicas_timeout_ms
+            ),
+        )
+
+    def wait(
+        self,
+        numreplicas: int,
+        timeout_ms: int = 1000,
+        *,
+        seq: Optional[int] = None,
+    ) -> int:
+        """Redis ``WAIT`` parity: block until ``numreplicas`` replicas
+        have acknowledged this client's last write (or ``seq``), up to
+        ``timeout_ms``; returns how many actually acked — possibly
+        fewer (WAIT reports, it does not raise). With no prior write
+        the server gates on its current log head."""
+        req: dict = {
+            "numreplicas": int(numreplicas),
+            "timeout_ms": int(timeout_ms),
+        }
+        target = self.last_write_seq if seq is None else seq
+        if target is not None:
+            req["seq"] = int(target)
+        return self._rpc("Wait", req)["nreplicas"]
 
     def stats(self, name: Optional[str] = None) -> dict:
         resp = self._rpc("Stats", {"name": name} if name else {})

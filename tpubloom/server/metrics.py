@@ -81,6 +81,10 @@ class Metrics:
         self.latency: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
         #: "<method>/<phase>" -> histogram (same buckets as latency)
         self.phases: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
+        #: time spent blocked on the synchronous-replication gate
+        #: (ISSUE 5): both the per-write commit barrier and the Wait RPC
+        #: observe here — the latency cost of the durability knob
+        self.waits = LatencyHistogram()
         self.started_at = time.time()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -96,6 +100,11 @@ class Metrics:
             for phase_name, phase_s in (phases or {}).items():
                 self.phases[f"{method}/{phase_name}"].observe(phase_s)
 
+    def observe_wait(self, seconds: float) -> None:
+        """File one replica-ack wait (commit barrier or Wait RPC)."""
+        with self._lock:
+            self.waits.observe(seconds)
+
     def snapshot(self) -> dict:
         from tpubloom.obs import counters as global_counters
 
@@ -105,6 +114,7 @@ class Metrics:
                 "counters": dict(self.counters),
                 "latency": {k: v.summary() for k, v in self.latency.items()},
                 "phases": {k: v.summary() for k, v in self.phases.items()},
+                "wait_barrier": self.waits.summary(),
                 "process_counters": global_counters.global_counters(),
             }
 
@@ -117,4 +127,5 @@ class Metrics:
                 "bucket_bounds_us": list(LatencyHistogram.BUCKETS),
                 "latency": {k: v.export() for k, v in self.latency.items()},
                 "phases": {k: v.export() for k, v in self.phases.items()},
+                "waits": self.waits.export(),
             }

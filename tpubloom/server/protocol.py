@@ -42,6 +42,7 @@ METHODS = (
     "SlowlogReset",
     "Promote",
     "ReplicaOf",
+    "Wait",
 )
 
 #: Server-streaming RPCs (ISSUE 3): each response frame is one msgpack
@@ -55,6 +56,18 @@ STREAM_METHODS = (
     "Monitor",
 )
 
+#: Client-streaming RPCs (ISSUE 5): each REQUEST frame is one msgpack
+#: map; the server answers one map when the stream ends. ``ReplAck`` is
+#: the replica→primary acknowledgement channel of the synchronous-
+#: replication path: frames ``{"sid": <session id from the sync frame>,
+#: "seq": <newest op seq fully applied>}``, coalesced latest-wins and
+#: re-sent periodically so a lost frame heals. The primary folds them
+#: into per-replica acked cursors that the ``Wait`` RPC and the
+#: ``min-replicas-to-write`` commit barrier block on.
+CLIENT_STREAM_METHODS = (
+    "ReplAck",
+)
+
 #: Mutating RPCs: replicated through the op log, rejected with
 #: ``READONLY`` on replicas (Redis ``replica-read-only`` parity). A
 #: mutating request MAY carry the caller's cached topology ``epoch``
@@ -63,6 +76,22 @@ STREAM_METHODS = (
 MUTATING_METHODS = frozenset(
     {"CreateFilter", "DropFilter", "InsertBatch", "DeleteBatch", "Clear"}
 )
+
+#: Durability-gate RPC (ISSUE 5, Redis ``WAIT`` parity): ``Wait``
+#: ``{numreplicas, timeout_ms, seq?}`` blocks until at least
+#: ``numreplicas`` replicas have acknowledged every record up to ``seq``
+#: (default: the server's current log head; clients send their last
+#: write's ``repl_seq``) and answers ``{nreplicas}`` — the count
+#: actually acked, even when below the target (Redis WAIT returns the
+#: count, it does not error). Mutating requests MAY carry
+#: ``min_replicas`` (+ ``min_replicas_timeout_ms``) to demand a
+#: per-request commit barrier stronger than the server's
+#: ``--min-replicas-to-write`` default; a barrier that times out answers
+#: ``NOT_ENOUGH_REPLICAS`` (Redis ``NOREPLICAS`` parity) with
+#: ``details={acked, needed, seq, applied: true}`` — the write DID apply
+#: and IS logged locally, only the quorum ack is missing, so a retry
+#: under the same rid re-waits on the same record instead of
+#: re-applying.
 
 #: HA control-plane RPCs (ISSUE 4): ``Promote`` (replica→primary,
 #: ``REPLICAOF NO ONE`` parity) and ``ReplicaOf`` (re-point/demote,

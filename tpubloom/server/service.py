@@ -88,6 +88,25 @@ High availability (ISSUE 4 — :mod:`tpubloom.ha`):
 * **replica durability** — with a state dir, the replication cursor
   (``repl_cursor.json``) and creation manifest persist; a replica
   restart restores filters from local checkpoints and PARTIAL-resyncs.
+
+Synchronous replication (ISSUE 5 — ``WAIT`` / ``min-replicas-to-write``
+parity):
+
+* **replica acks** — replicas report their applied cursor back on a
+  client-streaming ``ReplAck`` RPC (:func:`tpubloom.repl.primary.
+  repl_ack`); :class:`ReplicaSessions` tracks per-replica acked seqs
+  (gauge ``repl_acked_seq{replica}``).
+* **commit barrier** — with ``--min-replicas-to-write N`` (or a
+  per-request ``min_replicas``), each mutating RPC blocks AFTER its
+  op-log append, outside all locks, until N replicas acked the record
+  (:meth:`BloomService.commit_barrier`); timeout →
+  ``NOT_ENOUGH_REPLICAS`` (+ Health ``DEGRADED``), the local apply
+  stands (Redis semantics — WAIT never rolls back).
+* **Wait RPC** — Redis ``WAIT numreplicas timeout`` parity, keyed to
+  the caller's last-write ``repl_seq``; returns the achieved count.
+* a quorum-acked write is by construction on the most-caught-up
+  replica, which is exactly the sentinel's promotion pick — so it
+  survives a primary SIGKILL *without* the client rid re-drive.
 """
 
 from __future__ import annotations
@@ -153,6 +172,10 @@ class _Managed:
 #: lookups holding no device buffers, and the HA verbs (Promote /
 #: ReplicaOf) must land on an overloaded cluster — a failover that can
 #: be shed is not a failover.
+#: Wait is deliberately NOT here: it parks a worker thread for up to its
+#: timeout, so under overload it must count against --max-in-flight and
+#: shed like any data-plane call (Redis WAIT is a normal command too) —
+#: unsheddable Waits could exhaust the whole pool and starve Health.
 UNSHEDDABLE = frozenset(
     {"Health", "ListFilters", "SlowlogGet", "SlowlogReset",
      "Promote", "ReplicaOf"}
@@ -171,6 +194,14 @@ RETRY_AFTER_CAP_FACTOR = 32
 #: Commit-point appends between checkpoint-keyed log-truncation sweeps.
 TRUNCATE_EVERY_APPENDS = 64
 
+#: Default commit-barrier / Wait budget when neither the server flag nor
+#: the request provides one (ms).
+DEFAULT_MIN_REPLICAS_MAX_LAG_MS = 1000
+
+#: A Wait RPC with timeout_ms<=0 would block a worker thread forever
+#: (Redis WAIT 0 semantics); clamp to this ceiling instead.
+WAIT_TIMEOUT_CAP_S = 60.0
+
 
 class BloomService:
     """Method handlers; state = {name: _Managed}."""
@@ -188,6 +219,8 @@ class BloomService:
         epoch: Optional[int] = None,
         repl_batch_bytes: Optional[int] = None,
         listen_address: Optional[str] = None,
+        min_replicas_to_write: int = 0,
+        min_replicas_max_lag_ms: int = DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -199,7 +232,15 @@ class BloomService:
         retryable (0 disables it). ``oplog`` attaches a
         :class:`tpubloom.repl.OpLog` (this process becomes a replication
         primary + AOF-durable); ``read_only=True`` makes it a replica
-        (mutating RPCs answer ``READONLY``)."""
+        (mutating RPCs answer ``READONLY``).
+
+        ``min_replicas_to_write`` (ISSUE 5, Redis ``min-replicas-to-
+        write`` parity) gates every mutating RPC behind a durability
+        quorum: after the op-log append the handler blocks until that
+        many replicas have ACKED the record's seq, for at most
+        ``min_replicas_max_lag_ms`` — timeout answers
+        ``NOT_ENOUGH_REPLICAS`` (Redis ``NOREPLICAS``). Requests may
+        demand a STRONGER per-call quorum via ``min_replicas``."""
         self._filters: dict[str, _Managed] = {}
         self._lock = threading.Lock()
         self._sink_factory = sink_factory or (lambda config: None)
@@ -225,6 +266,17 @@ class BloomService:
         self.oplog = oplog
         self.read_only = read_only
         self.repl_sessions = repl_primary.ReplicaSessions()
+        # -- synchronous replication (ISSUE 5) --
+        #: server-wide durability quorum for mutating RPCs (0 = async,
+        #: the pre-ISSUE-5 behavior); per-request ``min_replicas`` can
+        #: only strengthen it
+        self.min_replicas_to_write = int(min_replicas_to_write or 0)
+        #: how long the commit barrier (and a default Wait) blocks for
+        #: the quorum before giving up
+        self.min_replicas_max_lag_ms = int(min_replicas_max_lag_ms)
+        #: last time a commit barrier timed out — Health reports
+        #: DEGRADED ("not_enough_replicas") for a window after
+        self._last_quorum_fail_time = 0.0
         self.monitor_hub = repl_monitor.MonitorHub()
         #: set by ReplicaApplier when this process follows a primary
         self.replica_applier = None
@@ -349,6 +401,129 @@ class BloomService:
         with self._admit_lock:
             self._draining = True
 
+    # -- synchronous replication: commit barrier + Wait (ISSUE 5) ------------
+
+    def commit_barrier(self, req: dict, resp: dict) -> dict:
+        """Durability gate for one mutating RPC, run by the wrapper AFTER
+        the handler returned (so no filter/registry lock is held while
+        blocking). The quorum target is the server's
+        ``min_replicas_to_write`` or the request's ``min_replicas``,
+        whichever is STRONGER; 0 (the default) is a no-op.
+
+        The write has already applied and its record is in the op log —
+        ``resp["repl_seq"]`` names it. Block until the quorum acked that
+        seq; on timeout raise ``NOT_ENOUGH_REPLICAS`` (Redis
+        ``NOREPLICAS``) with ``details={acked, needed, seq, applied:
+        True}``: the op is NOT rolled back (Redis WAIT semantics — the
+        local apply stands), the caller just knows it is not yet
+        quorum-durable. A retry under the same rid answers from the
+        dedup cache / seq gates and RE-WAITS on the same record instead
+        of double-applying."""
+        needed = max(
+            self.min_replicas_to_write, int(req.get("min_replicas") or 0)
+        )
+        if needed <= 0:
+            return resp
+        seq = resp.get("repl_seq")
+        if seq is None:
+            if self.oplog is None:
+                # without an op log there is no record a replica could
+                # ever ack — refuse loudly rather than return a
+                # durability ack the topology cannot honor
+                raise protocol.BloomServiceError(
+                    "NOT_ENOUGH_REPLICAS",
+                    f"min_replicas={needed} requires replication (start "
+                    f"the server with --repl-log-dir)",
+                    details={"acked": 0, "needed": needed, "applied": True},
+                )
+            # logged nothing because the call was a NO-OP (exist_ok
+            # create of an existing filter, drop of a missing one):
+            # there is no new record to make durable, so the quorum has
+            # nothing to say about it
+            return resp
+        timeout_ms = req.get("min_replicas_timeout_ms")
+        if timeout_ms is None:  # explicit 0 = probe: fail unless already acked
+            timeout_ms = self.min_replicas_max_lag_ms
+        timeout_ms = int(timeout_ms)
+        connected = self.repl_sessions.count()
+        if connected < needed:
+            # Redis min-replicas-to-write parity: with fewer replicas
+            # even CONNECTED than the quorum needs, waiting is futile —
+            # fail fast so an isolated primary rejects writes in
+            # microseconds, not after every barrier timeout
+            self._quorum_failed(needed, 0)
+            raise protocol.BloomServiceError(
+                "NOT_ENOUGH_REPLICAS",
+                f"durability quorum needs {needed} replica(s), only "
+                f"{connected} connected",
+                details={"acked": 0, "needed": needed, "seq": seq,
+                         "connected": connected, "applied": True},
+            )
+        t0 = time.perf_counter()
+        acked = self.repl_sessions.wait_acked(
+            seq, needed, timeout_ms / 1000.0, require_connected=needed
+        )
+        self.metrics.observe_wait(time.perf_counter() - t0)
+        if acked < needed:
+            self._quorum_failed(needed, acked)
+            raise protocol.BloomServiceError(
+                "NOT_ENOUGH_REPLICAS",
+                f"only {acked}/{needed} replica(s) acked seq {seq} "
+                f"within {timeout_ms}ms",
+                details={"acked": acked, "needed": needed, "seq": seq,
+                         "timeout_ms": timeout_ms, "applied": True},
+            )
+        self.metrics.count("quorum_writes_acked")
+        resp["acked_replicas"] = acked
+        return resp
+
+    def _quorum_failed(self, needed: int, acked: int) -> None:
+        self._last_quorum_fail_time = time.time()
+        self.metrics.count("quorum_write_failures")
+        log.warning(
+            "commit barrier: %d/%d replica ack(s) — write applied "
+            "locally but is not quorum-durable", acked, needed,
+        )
+
+    def Wait(self, req: dict) -> dict:
+        """Redis ``WAIT numreplicas timeout`` parity: block until
+        ``numreplicas`` replicas have acknowledged every record up to
+        ``seq`` (the caller's last write — clients send the ``repl_seq``
+        their last mutating response carried; default: the current log
+        head), then answer ``{nreplicas}`` — the count actually acked,
+        even when short of the target (WAIT reports, it does not
+        error). ``numreplicas=0`` answers immediately with the current
+        count — the cheap durability probe."""
+        if self.read_only:
+            raise protocol.BloomServiceError(
+                "UNSUPPORTED",
+                "WAIT is a primary-side command (this server is a "
+                "replica)",
+            )
+        seq = req.get("seq")
+        if seq is None:
+            seq = self.oplog.last_seq if self.oplog is not None else 0
+        numreplicas = int(req.get("numreplicas") or 0)
+        timeout_ms = req.get("timeout_ms")
+        if timeout_ms is None:
+            timeout_ms = self.min_replicas_max_lag_ms
+        timeout_ms = int(timeout_ms)
+        timeout_s = (
+            WAIT_TIMEOUT_CAP_S
+            if timeout_ms <= 0  # Redis WAIT-0 "forever", capped
+            else min(WAIT_TIMEOUT_CAP_S, timeout_ms / 1000.0)
+        )
+        t0 = time.perf_counter()
+        acked = self.repl_sessions.wait_acked(int(seq), numreplicas, timeout_s)
+        if numreplicas > 0:
+            self.metrics.observe_wait(time.perf_counter() - t0)
+        return {
+            "ok": True,
+            "nreplicas": acked,
+            "seq": int(seq),
+            "epoch": self.epoch,
+        }
+
     # -- high availability: epoch + chained re-append (ISSUE 4) --------------
 
     def adopt_epoch(self, epoch: int) -> None:
@@ -425,7 +600,7 @@ class BloomService:
         mf: Optional[_Managed] = None,
         *,
         may_truncate: bool = True,
-    ) -> None:
+    ) -> Optional[int]:
         """Append one committed mutating op to the op log (no-op without
         a log, during replay, and on replicas — a chained replica's log
         is fed by :meth:`reappend_record`, which preserves the upstream
@@ -434,9 +609,11 @@ class BloomService:
         under — log order is apply order. ``may_truncate=False`` for
         callers holding ``self._lock`` (Create/Drop): the truncation
         sweep re-takes it and the lock is not re-entrant — their sweep
-        runs on a later data-plane append."""
+        runs on a later data-plane append. Returns the record's seq
+        (``None`` when nothing was logged) — what the commit barrier
+        blocks on and what mutating responses echo as ``repl_seq``."""
         if self.oplog is None or self._replaying or self._stream_fed:
-            return
+            return None
         try:
             seq = self.oplog.append(method, req, rid=obs.current_rid())
         except Exception as e:
@@ -457,6 +634,7 @@ class BloomService:
         if may_truncate and self._appends_since_truncate >= TRUNCATE_EVERY_APPENDS:
             self._appends_since_truncate = 0
             self._maybe_truncate_log()
+        return seq
 
     def _maybe_truncate_log(self) -> None:
         """Checkpoint-keyed log GC: records every filter's newest LANDED
@@ -679,6 +857,16 @@ class BloomService:
                     reasons.append(f"checkpoint_corrupt:{name}")
         if time.time() - self._last_shed_time < SHED_DEGRADED_WINDOW_S:
             reasons.append("shedding")
+        if self.min_replicas_to_write > 0:
+            connected = self.repl_sessions.count()
+            if connected < self.min_replicas_to_write:
+                # an isolated primary under min-replicas-to-write is
+                # refusing writes RIGHT NOW — the operator must see why
+                reasons.append(
+                    f"min_replicas:{connected}/{self.min_replicas_to_write}"
+                )
+        if time.time() - self._last_quorum_fail_time < SHED_DEGRADED_WINDOW_S:
+            reasons.append("not_enough_replicas")
         ra = self.replica_applier
         if ra is not None and ra.link not in ("connected", "syncing"):
             # a replica serving reads off a dead link is serving stale
@@ -906,28 +1094,34 @@ class BloomService:
             # log BEFORE publishing: _get reads _filters lock-free, so a
             # concurrent insert on the new filter must not be able to log
             # a seq below the create record's
-            self._log_create(req, mf, restored)
+            seq = self._log_create(req, mf, restored)
             self._filters[name] = mf
             self.metrics.count("filters_created")
-            return {
+            resp = {
                 "ok": True,
                 "existed": False,
                 "restored_seq": getattr(filt, "_restored_seq", None),
                 "config": config.to_dict(),
             }
+            if seq is not None:
+                resp["repl_seq"] = seq
+            return resp
 
-    def _log_create(self, req: dict, mf: _Managed, restored) -> None:
+    def _log_create(self, req: dict, mf: _Managed, restored) -> Optional[int]:
         """Op-log a landed CreateFilter (+ the creation manifest). A
         create that bootstrapped state from a checkpoint is stamped
         ``restored_seq`` — replicas cannot reproduce those bytes from
         records, so applying such a record triggers a full resync (the
         snapshot carries the state)."""
-        logged = {k: v for k, v in req.items() if k != "rid"}
+        logged = {k: v for k, v in req.items()
+                  if k not in ("rid", "min_replicas",
+                               "min_replicas_timeout_ms")}
         if restored is not None:
             logged["restored_seq"] = getattr(restored, "_restored_seq", None)
-        self._log_op("CreateFilter", logged, mf, may_truncate=False)
+        seq = self._log_op("CreateFilter", logged, mf, may_truncate=False)
         self._manifest_put(req["name"], {k: v for k, v in logged.items()
                                          if k != "restored_seq"})
+        return seq
 
     # -- creation manifest ---------------------------------------------------
     #
@@ -1057,26 +1251,32 @@ class BloomService:
         mf.applied_seq = int(
             getattr(filt, "_restored_meta", {}).get("repl_seq", 0) or 0
         )
-        self._log_create(req, mf, restored)  # before publish — see CreateFilter
+        seq = self._log_create(req, mf, restored)  # before publish — see CreateFilter
         self._filters[name] = mf
         self.metrics.count("filters_created")
-        return {
+        resp = {
             "ok": True,
             "existed": False,
             "restored_seq": getattr(filt, "_restored_seq", None),
             "config": base.to_dict(),
             "scalable": policy,
         }
+        if seq is not None:
+            resp["repl_seq"] = seq
+        return resp
 
     def DropFilter(self, req: dict) -> dict:
+        seq = None
         with self._lock:
             mf = self._filters.pop(req["name"], None)
             if mf is not None:
                 # inside the lock: a concurrent CreateFilter of the same
                 # name must not log its create before this drop
-                self._log_op(
+                seq = self._log_op(
                     "DropFilter",
-                    {k: v for k, v in req.items() if k != "rid"},
+                    {k: v for k, v in req.items()
+                     if k not in ("rid", "min_replicas",
+                                  "min_replicas_timeout_ms")},
                     may_truncate=False,
                 )
                 self._manifest_remove(req["name"])
@@ -1094,7 +1294,10 @@ class BloomService:
                     "final checkpoint did not land: "
                     + repr(mf.checkpointer.last_error),
                 )
-        return {"ok": True, "existed": True}
+        resp = {"ok": True, "existed": True}
+        if seq is not None:
+            resp["repl_seq"] = seq
+        return resp
 
     def ListFilters(self, req: dict) -> dict:
         with self._lock:
@@ -1144,13 +1347,15 @@ class BloomService:
             # whose snapshot contains this batch — its repl_seq stamp
             # (sampled from applied_seq at trigger time) must therefore
             # already include this op, or a crash-replay re-applies it
-            self._log_op(
+            seq = self._log_op(
                 "InsertBatch", {"name": req["name"], "keys": req["keys"]}, mf
             )
             if mf.checkpointer:
                 mf.checkpointer.notify_inserts(len(req["keys"]))
         self.metrics.count("keys_inserted", len(req["keys"]))
         resp = {"ok": True, "n": len(req["keys"])}
+        if seq is not None:
+            resp["repl_seq"] = seq
         if presence is not None:
             resp["presence"] = np.packbits(np.asarray(presence)).tobytes()
         if replay_unsafe:
@@ -1212,11 +1417,13 @@ class BloomService:
             return cached
         with mf.lock:
             mf.filter.delete_batch(req["keys"])
-            self._log_op(
+            seq = self._log_op(
                 "DeleteBatch", {"name": req["name"], "keys": req["keys"]}, mf
             )
         self.metrics.count("keys_deleted", len(req["keys"]))
         resp = {"ok": True, "n": len(req["keys"])}
+        if seq is not None:
+            resp["repl_seq"] = seq
         self._dedup_put(rid, resp)
         return resp
 
@@ -1224,8 +1431,11 @@ class BloomService:
         mf = self._get(req["name"])
         with mf.lock:
             mf.filter.clear()
-            self._log_op("Clear", {"name": req["name"]}, mf)
-        return {"ok": True}
+            seq = self._log_op("Clear", {"name": req["name"]}, mf)
+        resp = {"ok": True}
+        if seq is not None:
+            resp["repl_seq"] = seq
+        return resp
 
     def Stats(self, req: dict) -> dict:
         if "name" in req:
@@ -1401,6 +1611,15 @@ def _wrap(service: BloomService, method_name: str):
                             details={"epoch": service.epoch},
                         )
                     resp = handler(req)
+                    # durability gate (ISSUE 5): block OUTSIDE every
+                    # lock until the quorum acked this write's record;
+                    # a dedup-cache replay re-enters here with the
+                    # cached repl_seq and re-waits on the same record
+                    if (
+                        method_name in protocol.MUTATING_METHODS
+                        and resp.get("ok")
+                    ):
+                        resp = service.commit_barrier(req, resp)
                     # post-apply fault: the handler's effect landed but the
                     # response is "lost" — the case rid-dedup must absorb
                     faults.fire("rpc.post_handle")
@@ -1461,6 +1680,24 @@ _STREAM_BEHAVIORS = {
     "Monitor": repl_monitor.monitor_stream,
 }
 
+#: Client-streaming RPC name -> behavior(service, request_iterator,
+#: context) -> response dict (ISSUE 5).
+_CLIENT_STREAM_BEHAVIORS = {
+    "ReplAck": repl_primary.repl_ack,
+}
+
+
+def _wrap_client_stream(service: BloomService, method_name: str):
+    behavior = _CLIENT_STREAM_BEHAVIORS[method_name]
+
+    def stream_unary(request_iterator, context) -> bytes:
+        service.metrics.count(f"stream_{method_name}_opened")
+        # an injected repl.ack_recv (or any bug) propagates: grpc fails
+        # the RPC and the replica re-opens its ack stream on heartbeat
+        return protocol.encode(behavior(service, request_iterator, context))
+
+    return grpc.stream_unary_rpc_method_handler(stream_unary)
+
 
 def _wrap_stream(service: BloomService, method_name: str):
     gen_fn = _STREAM_BEHAVIORS[method_name]
@@ -1483,16 +1720,25 @@ def _wrap_stream(service: BloomService, method_name: str):
 def build_server(
     service: BloomService,
     address: str = "127.0.0.1:50051",
-    max_workers: int = 8,
+    max_workers: int = 16,
 ) -> tuple[grpc.Server, int]:
     """Create (not start) a grpc.Server with the BloomService mounted.
 
     Returns ``(server, bound_port)``; pass port 0 in ``address`` for an
-    ephemeral port.
+    ephemeral port. ``max_workers`` sizes the handler thread pool: every
+    connected replica parks TWO workers for its stream lifetimes
+    (ReplStream out + ReplAck in, ISSUE 5), and blocked Wait/commit-
+    barrier calls hold theirs too — size generously.
     """
     handlers = {m: _wrap(service, m) for m in protocol.METHODS}
     handlers.update(
         {m: _wrap_stream(service, m) for m in protocol.STREAM_METHODS}
+    )
+    handlers.update(
+        {
+            m: _wrap_client_stream(service, m)
+            for m in protocol.CLIENT_STREAM_METHODS
+        }
     )
     generic = grpc.method_handlers_generic_handler(protocol.SERVICE, handlers)
     server = grpc.server(
@@ -1676,7 +1922,28 @@ def main(argv: Optional[list] = None) -> None:
         help="address to announce to primaries/sentinels (Redis "
         "replica-announce parity; default 127.0.0.1:<port>)",
     )
+    parser.add_argument(
+        "--min-replicas-to-write",
+        type=int,
+        default=0,
+        metavar="N",
+        help="synchronous-replication quorum (Redis min-replicas-to-write "
+        "parity): each mutating RPC blocks after its op-log append until "
+        "N replicas acknowledge the record; timeout answers "
+        "NOT_ENOUGH_REPLICAS. Requires --repl-log-dir. Default 0 (async)",
+    )
+    parser.add_argument(
+        "--min-replicas-max-lag-ms",
+        type=int,
+        default=DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
+        metavar="M",
+        help="how long the commit barrier (and a Wait with no timeout) "
+        "waits for the replica quorum before giving up "
+        f"(default {DEFAULT_MIN_REPLICAS_MAX_LAG_MS})",
+    )
     args = parser.parse_args(argv)
+    if args.min_replicas_to_write and not args.repl_log_dir:
+        parser.error("--min-replicas-to-write requires --repl-log-dir")
     ckpt_dir = args.checkpoint_dir
     sink_factory = (
         (lambda config: ckpt.FileSink(ckpt_dir)) if ckpt_dir else (lambda config: None)
@@ -1699,6 +1966,8 @@ def main(argv: Optional[list] = None) -> None:
         read_only=bool(args.replica_of),
         repl_batch_bytes=args.repl_batch_bytes,
         listen_address=announce,
+        min_replicas_to_write=args.min_replicas_to_write,
+        min_replicas_max_lag_ms=args.min_replicas_max_lag_ms,
     )
     if oplog is not None:
         stats = service.replay_oplog()

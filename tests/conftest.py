@@ -27,3 +27,64 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def lock_check_armed(tmp_path_factory):
+    """ISSUE 6: arm the runtime lock-order / held-while-blocking tracker
+    (:mod:`tpubloom.utils.locks`) for a whole chaos module.
+
+    In-process services are covered by ``set_enabled(True)`` — every lock
+    constructed while the module runs is a tracked, named lock feeding
+    the acquisition graph. Caveat: module-level singleton locks
+    (``faults._lock``, ``obs.counters._lock``, ``native``'s build lock)
+    are constructed at import/collection time, so in a local run without
+    ``TPUBLOOM_LOCK_CHECK=1`` in the environment they stay bare and
+    untracked; the CI chaos shard exports the env var, which is where
+    those singletons get full coverage. Subprocess servers (the
+    SIGKILL-failover and
+    drain scenarios spawn real children) inherit ``TPUBLOOM_LOCK_CHECK``
+    plus a report directory through ``os.environ``; each child that
+    exits cleanly dumps a ``lockcheck-<pid>.json`` report there
+    (SIGKILLed children can't — that's fine, their locks were tracked
+    until the kill and the survivors' reports still land).
+
+    Teardown asserts ZERO violations across the in-process tracker and
+    every subprocess report — a new lock-order cycle or a blocking call
+    under a registry/filter lock anywhere in the chaos run fails the
+    module, which is the ISSUE-6 acceptance gate."""
+    from tpubloom.utils import locks
+
+    report_dir = tmp_path_factory.mktemp("lockcheck")
+    saved = {
+        k: os.environ.get(k) for k in (locks.ENV_VAR, locks.REPORT_DIR_ENV)
+    }
+    os.environ[locks.ENV_VAR] = "1"
+    os.environ[locks.REPORT_DIR_ENV] = str(report_dir)
+    locks.set_enabled(True)
+    locks.reset()
+    yield
+    vios = list(locks.violations())
+    locks.set_enabled(None)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    for path in sorted(report_dir.glob("lockcheck-*.json")):
+        rep = json.loads(path.read_text())
+        vios.extend(
+            {**v, "subprocess": path.name} for v in rep["violations"]
+        )
+    assert not vios, (
+        "lock-check violations recorded during the module:\n"
+        + "\n".join(
+            f"  [{v.get('subprocess', 'in-process')}] {v['kind']}: "
+            f"{v['message']} @ {v['site']}"
+            for v in vios
+        )
+    )

@@ -37,6 +37,11 @@ from tpubloom.server.protocol import BloomServiceError
 from tpubloom.server.service import BloomService, build_server
 from tpubloom.utils.crc32c import crc32c
 
+# ISSUE 6: the whole chaos module runs with the runtime lock-order /
+# held-while-blocking tracker armed (in-process AND subprocess servers);
+# teardown asserts zero violations — see tests/conftest.py.
+pytestmark = pytest.mark.usefixtures("lock_check_armed")
+
 
 @pytest.fixture(autouse=True)
 def _disarm_all():
@@ -544,8 +549,8 @@ def test_client_retries_delete_after_transport_loss(tmp_path):
     real_call = client._call_once
     dropped = []
 
-    def flaky(method, req):
-        resp = real_call(method, req)
+    def flaky(method, req, timeout=None):
+        resp = real_call(method, req, timeout=timeout)
         if method == "DeleteBatch" and not dropped:
             dropped.append(req["rid"])
             raise LostResponse()  # the apply landed; the answer did not

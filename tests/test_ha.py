@@ -47,6 +47,10 @@ from tpubloom.server.client import BloomClient, fetch_topology
 from tpubloom.server.protocol import BloomServiceError
 from tpubloom.server.service import BloomService, build_server
 
+# ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
+# module (asserted violation-free at teardown — tests/conftest.py).
+pytestmark = pytest.mark.usefixtures("lock_check_armed")
+
 
 @pytest.fixture(autouse=True)
 def _disarm_all():

@@ -74,6 +74,18 @@ GOLDEN = {
 GOLDEN_ACK_FRAME = "82a373696400a373657107"
 GOLDEN_ACK_FRAME_DICT = {"sid": 0, "seq": 7}
 
+#: server-streaming requests (ISSUE 6 — the project lint's protocol-
+#: coverage check found these two uncovered): a cursor-less ReplStream
+#: open (full resync) and a name-filtered Monitor subscription
+GOLDEN_STREAM = {
+    "ReplStream": ("ReplStream", "80"),
+    "Monitor": ("Monitor", "81a46e616d65a6676f6c64656e"),
+}
+GOLDEN_STREAM_DICTS = {
+    "ReplStream": {},
+    "Monitor": {"name": "golden"},
+}
+
 #: the dict each fixture encodes (the pin below keeps python<->ruby
 #: encodings provably in sync; regenerate hex from these on change)
 GOLDEN_DICTS = {
@@ -110,6 +122,11 @@ def test_every_method_has_a_golden():
         "golden fixtures must cover every protocol method; missing: "
         f"{set(protocol.METHODS) - covered}"
     )
+    stream_covered = {m for m, _ in GOLDEN_STREAM.values()}
+    assert stream_covered == set(protocol.STREAM_METHODS), (
+        "golden fixtures must cover every streaming method; missing: "
+        f"{set(protocol.STREAM_METHODS) - stream_covered}"
+    )
 
 
 def test_golden_bytes_match_ruby_encoding():
@@ -123,6 +140,10 @@ def test_golden_bytes_match_ruby_encoding():
     assert msgpack.packb(
         GOLDEN_ACK_FRAME_DICT, use_bin_type=True
     ).hex() == GOLDEN_ACK_FRAME, "ReplAck frame fixture drifted"
+    for name, (_, hexbytes) in GOLDEN_STREAM.items():
+        assert msgpack.packb(
+            GOLDEN_STREAM_DICTS[name], use_bin_type=True
+        ).hex() == hexbytes, f"stream fixture {name} drifted"
 
 
 @pytest.fixture()
@@ -231,6 +252,73 @@ def test_golden_replay_against_live_server(raw_server):
     r = msgpack.unpackb(fn(bad), raw=False)
     assert r["ok"] is False and r["error"]["code"] == "NOT_FOUND"
     assert isinstance(r["error"]["message"], str)
+
+
+def test_golden_stream_replay(tmp_path):
+    """ReplStream + Monitor golden requests replayed RAW (ISSUE 6): the
+    frame kinds and the fields replicas/monitor clients read must hold."""
+    from tpubloom.repl import OpLog
+
+    service = BloomService(
+        sink_factory=lambda config: None,
+        oplog=OpLog(str(tmp_path / "oplog")),
+    )
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        assert _call(channel, *GOLDEN["CreateFilter"])["ok"]
+        assert _call(channel, *GOLDEN["InsertBatch"])["ok"]
+
+        # ReplStream, cursor-less: full_sync_begin -> snapshot per
+        # filter -> full_sync_end carrying cursor/log_id/epoch/sid
+        method, hexbytes = GOLDEN_STREAM["ReplStream"]
+        call = channel.unary_stream(
+            protocol.method_path(method),
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(bytes.fromhex(hexbytes), timeout=10)
+        frames = []
+        for raw in call:
+            frames.append(msgpack.unpackb(raw, raw=False))
+            if frames[-1]["kind"] == "full_sync_end":
+                break
+        call.cancel()
+        kinds = [f["kind"] for f in frames]
+        assert kinds[0] == "full_sync_begin" and kinds[-1] == "full_sync_end"
+        assert frames[0]["filters"] == ["golden"]
+        snap = next(f for f in frames if f["kind"] == "snapshot")
+        assert snap["name"] == "golden" and isinstance(snap["blob"], bytes)
+        assert isinstance(snap["applied_seq"], int)
+        end = frames[-1]
+        assert {"cursor", "log_id", "epoch", "sid"} <= set(end)
+
+        # Monitor, name-filtered: hello first, then one op event per
+        # matching finished request
+        method, hexbytes = GOLDEN_STREAM["Monitor"]
+        call = channel.unary_stream(
+            protocol.method_path(method),
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(bytes.fromhex(hexbytes), timeout=10)
+        it = iter(call)
+        hello = msgpack.unpackb(next(it), raw=False)
+        assert hello["kind"] == "hello" and hello["filter"] == "golden"
+        assert _call(channel, *GOLDEN["QueryBatch"])["ok"]
+        event = None
+        for raw in it:
+            frame = msgpack.unpackb(raw, raw=False)
+            if frame["kind"] == "op":
+                event = frame
+                break
+        call.cancel()
+        assert event is not None
+        assert event["method"] == "QueryBatch" and event["name"] == "golden"
+        assert {"ts", "rid", "batch", "duration_s", "ok"} <= set(event)
+    finally:
+        channel.close()
+        srv.stop(grace=None)
+        service.oplog.close()
 
 
 def test_golden_ack_frame_replay(raw_service_server):

@@ -42,6 +42,10 @@ from tpubloom.server.client import BloomClient, fetch_topology
 from tpubloom.server.protocol import BloomServiceError
 from tpubloom.server.service import BloomService, build_server
 
+# ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
+# module (asserted violation-free at teardown — tests/conftest.py).
+pytestmark = pytest.mark.usefixtures("lock_check_armed")
+
 
 @pytest.fixture(autouse=True)
 def _disarm_all():
@@ -410,6 +414,163 @@ def test_slow_replica_times_out_then_catches_up(tmp_path):
         poplog.close()
 
 
+def test_ack_age_gate_counts_only_fresh_acks():
+    """ISSUE 6 satellite (Redis min-replicas-max-lag parity): a replica
+    that acked a seq and then went SILENT stops counting toward an
+    age-gated quorum — its cursor is history, not durability."""
+    from tpubloom.repl.primary import ReplicaSessions
+
+    sess = ReplicaSessions()
+    sid = sess.register("test-peer")
+    sess.ack(sid, 5)
+    assert sess.count_acked(5) == 1
+    assert sess.count_acked(5, max_age=10.0) == 1
+    time.sleep(0.15)
+    # unaged counting still sees the ack; the freshness gate does not
+    assert sess.count_acked(5) == 1
+    assert sess.count_acked(5, max_age=0.1) == 0
+    # an age-gated barrier on a stale-but-connected replica TIMES OUT
+    # (it does not fast-fail: the session is connected) and reports the
+    # fresh count, without a notify ever arriving — the wait re-polls
+    # freshness on its own clock
+    t0 = time.monotonic()
+    assert sess.wait_acked(5, 1, 0.3, max_age=0.1) == 0
+    assert time.monotonic() - t0 < 5.0
+    # an idle re-ack of the SAME seq refreshes acked_at: fresh again
+    sess.ack(sid, 5)
+    assert sess.count_acked(5, max_age=0.1) == 1
+    assert sess.wait_acked(5, 1, 0.3, max_age=0.1) == 1
+
+
+def test_zero_lag_budget_disables_freshness_gate(tmp_path):
+    """Redis ``min-replicas-max-lag 0`` = the lag check is DISABLED,
+    not infinitely strict: quorum writes against a healthy replica must
+    succeed (and not busy-spin the barrier into a guaranteed timeout)."""
+    from tpubloom.repl.primary import ReplicaSessions
+
+    # unit: max_age=0 counts like no gate at all
+    sess = ReplicaSessions()
+    sid = sess.register("test-peer")
+    sess.ack(sid, 4)
+    time.sleep(0.05)
+    assert sess.count_acked(4, max_age=0) == 1
+    assert sess.wait_acked(4, 1, 0.2, max_age=0.0) == 1
+
+    # service: a 0 lag budget still lets a healthy quorum write through
+    psvc, psrv, pport, poplog = _primary(tmp_path, min_replicas_max_lag_ms=0)
+    c = BloomClient(f"127.0.0.1:{pport}")
+    rsvc, rsrv, rport, applier = _replica(tmp_path, pport)
+    try:
+        c.wait_ready()
+        c.create_filter("cnt", capacity=10_000, error_rate=0.01,
+                        counting=True)
+        _warm(c, applier, poplog)
+        resp = c._rpc(
+            "InsertBatch",
+            {"name": "cnt", "keys": [b"z1"], "min_replicas": 1,
+             "min_replicas_timeout_ms": 30_000},
+        )
+        assert resp["acked_replicas"] == 1
+        # no explicit wait budget: the default normally reuses the lag
+        # budget, but lag 0 must fall back to the stock budget instead
+        # of turning every quorum write into a 0ms instant probe
+        resp = c._rpc(
+            "InsertBatch",
+            {"name": "cnt", "keys": [b"z2"], "min_replicas": 1},
+        )
+        assert resp["acked_replicas"] == 1
+    finally:
+        c.close()
+        applier.stop()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        poplog.close()
+
+
+def test_idle_reack_wakes_age_gated_waiter():
+    """A quorum waiter blocked on FRESHNESS (seq already acked, frame
+    too old) must wake on the idle re-ack that refreshes it — the
+    re-ack advances no seq, so this pins the waiters-present notify."""
+    from tpubloom.repl.primary import ReplicaSessions
+
+    sess = ReplicaSessions()
+    sid = sess.register("test-peer")
+    sess.ack(sid, 3)
+    time.sleep(0.2)  # the ack frame goes stale for a 0.15s budget
+    got: list = []
+    t = threading.Thread(
+        target=lambda: got.append(sess.wait_acked(3, 1, 5.0, max_age=0.15)),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.05)
+    sess.ack(sid, 3)  # idle re-ack: same seq, fresh frame
+    t.join(timeout=10)
+    assert got == [1], got
+
+
+def test_dedup_rewait_rejects_stale_acks(tmp_path):
+    """The service-level customer of the freshness gate: a dedup-cache
+    replay re-waits on its original record's seq — which the replica
+    acked LONG AGO before going silent. Without the age gate the stale
+    cursor would satisfy the quorum forever; with it the barrier answers
+    NOT_ENOUGH_REPLICAS and names the stale ack, and a healed replica
+    (acks flowing again) satisfies the same re-drive."""
+    psvc, psrv, pport, poplog = _primary(
+        tmp_path, min_replicas_max_lag_ms=300
+    )
+    c = BloomClient(f"127.0.0.1:{pport}")
+    rsvc, rsrv, rport, applier = _replica(tmp_path, pport)
+    try:
+        c.wait_ready()
+        c.create_filter("cnt", capacity=10_000, error_rate=0.01,
+                        counting=True)
+        _warm(c, applier, poplog)
+        resp = c._rpc(
+            "InsertBatch",
+            {"name": "cnt", "keys": [b"fresh1"], "min_replicas": 1,
+             "min_replicas_timeout_ms": 30_000},
+        )
+        assert resp["acked_replicas"] == 1
+        rid = c.last_rid
+        # the replica stays CONNECTED but every ack frame (including the
+        # 0.5s periodic idle re-acks) is dropped in flight: acked_at
+        # ages past the 300ms lag budget while the acked seq stands
+        faults.arm("repl.ack", "always")
+        time.sleep(0.8)
+        with pytest.raises(BloomServiceError, match="NOT_ENOUGH_REPLICAS") as ei:
+            c._call_once(
+                "InsertBatch",
+                {"name": "cnt", "keys": [b"fresh1"], "rid": rid,
+                 "min_replicas": 1, "min_replicas_timeout_ms": 500},
+            )
+        details = ei.value.details
+        assert details["applied"] is True
+        assert details.get("stale_acks", 0) >= 1, (
+            f"the failure must name the stale ack, got {details}"
+        )
+        counters = psvc.metrics.snapshot()["counters"]
+        assert counters.get("quorum_stale_acks", 0) >= 1, counters
+        # heal: acks flow again, the periodic re-ack refreshes acked_at,
+        # and the SAME rid re-drive now passes the freshness gate
+        faults.reset()
+        resp = c._call_once(
+            "InsertBatch",
+            {"name": "cnt", "keys": [b"fresh1"], "rid": rid,
+             "min_replicas": 1, "min_replicas_timeout_ms": 30_000},
+        )
+        assert resp["acked_replicas"] == 1
+        # dedup replay: applied exactly once
+        c.delete_batch("cnt", [b"fresh1"])
+        assert not c.include("cnt", b"fresh1")
+    finally:
+        c.close()
+        applier.stop()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        poplog.close()
+
+
 def test_barrier_unblocks_when_last_replica_disconnects(tmp_path):
     """A quorum made unattainable MID-WAIT (the last replica
     disconnects while the barrier is blocked) must fail immediately,
@@ -560,8 +721,21 @@ def test_quorum_acked_survives_sigkill_without_redrive(tmp_path):
             timeout=90,
             msg="sentinel failover",
         )
-        topo = fetch_topology([s.address for s in sents])
-        assert topo is not None and topo["primary"] != f"127.0.0.1:{port}"
+        # fetch_topology answers from the FIRST sentinel that responds,
+        # which may not be the election leader — its view flips only
+        # when the leader's AnnounceTopology lands, so poll for the
+        # new primary instead of asserting on one snapshot
+        topo = None
+
+        def _new_primary():
+            nonlocal topo
+            topo = fetch_topology([s.address for s in sents])
+            return (
+                topo is not None
+                and topo["primary"] != f"127.0.0.1:{port}"
+            )
+
+        _wait(_new_primary, timeout=30, msg="topology announce")
 
         # re-drive DISABLED: a fresh client only READS the new primary —
         # every quorum-acked element must already be there, because the

@@ -1,0 +1,13 @@
+"""Project-specific correctness tooling (ISSUE 6).
+
+Two layers keep the concurrent subsystems honest:
+
+* :mod:`tpubloom.analysis.lint` — static AST checkers encoding the
+  invariants review kept re-finding in PRs 3-5 (no blocking calls under
+  the registry/filter locks, op-log append ordered before
+  ``notify_inserts``, protocol/fault-point/metric-name registries
+  closed under cross-reference). Run ``python -m tpubloom.analysis.lint``.
+* :mod:`tpubloom.utils.locks` — runtime lock-order and
+  held-while-blocking analysis behind the ``TPUBLOOM_LOCK_CHECK`` env
+  var, armed in the chaos suites.
+"""

@@ -1,0 +1,742 @@
+"""Static concurrency/consistency lint for the tpubloom tree (ISSUE 6).
+
+``python -m tpubloom.analysis.lint [paths...]`` (default: the installed
+``tpubloom/`` package) runs AST-based checkers that encode the
+project-specific invariants hand-review kept re-finding while PRs 3-5
+grew the replication stack. Zero dependencies beyond the stdlib; exit
+status 0 = clean, 1 = findings.
+
+Checks
+======
+
+``blocking-under-lock``
+    No blocking call — gRPC stubs (``_rpc``/``_call``/``_node``/
+    ``_peer``/``grpc.insecure_channel``), ``Condition.wait`` without a
+    timeout, fsync/flush/checkpoint IO (``os.fsync``, ``.flush()``,
+    ``ckpt.restore``/``_tracked_restore``, ``checkpointer.close``),
+    quorum waits (``wait_acked``, ``commit_barrier``), ``time.sleep``,
+    thread/worker ``join``, ``Future.result`` — lexically inside a
+    ``with`` on a registry/filter/admission mutex or a lock-named
+    condition (attributes like ``lock``, ``_lock``, ``_cond``,
+    ``_admit_lock`` ...). The runtime half of this check is
+    :func:`tpubloom.utils.locks.note_blocking`.
+
+``notify-before-append``
+    In any function that both appends to the op log (``_log_op`` /
+    ``_log_create`` / ``oplog.append``) and calls
+    ``checkpointer.notify_inserts``, every notify must come AFTER the
+    first append: a checkpoint triggered by its own batch must stamp
+    that batch's seq (the PR-3 crash-replay bug class).
+
+``fault-registry``
+    Every literal fault-point string passed to ``faults.fire`` /
+    ``arm`` / ``is_armed`` is declared in ``faults.KNOWN_POINTS`` —
+    and (tree mode) every declared point appears as a literal somewhere
+    outside the registry, so the vocabulary cannot rot.
+
+``metric-registry``
+    Every literal counter/gauge name emitted via ``counters.incr`` /
+    ``metrics.count`` / ``counters.set_gauge`` is declared in
+    :mod:`tpubloom.obs.names` under the right kind; (tree mode) every
+    declared name is emitted at least once, and no name is declared
+    twice or under both kinds.
+
+``protocol-coverage``
+    (tree mode) Every ``protocol.METHODS`` entry has a ``BloomService``
+    handler, a client call site, and a golden-wire test; streaming
+    methods are registered in the service behavior maps and golden-
+    tested.
+
+Suppressions
+============
+
+A finding is allowlisted inline, on the flagged line or its enclosing
+``with`` line::
+
+    mf.checkpointer.close()  # lint: allow(blocking-under-lock): unpublished
+
+The reason is mandatory: an empty reason is itself a finding
+(``suppression-reason``), as are suppressions naming unknown checks
+(``unknown-suppression``) and suppressions that no longer match any
+finding (``unused-suppression``) — allowlists cannot rot either.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+CHECKS = (
+    "blocking-under-lock",
+    "notify-before-append",
+    "fault-registry",
+    "metric-registry",
+    "protocol-coverage",
+    "suppression-reason",
+    "unknown-suppression",
+    "unused-suppression",
+)
+
+#: ``with`` context attributes treated as "a lock is held inside".
+LOCK_ATTRS = frozenset(
+    {
+        "lock",
+        "_lock",
+        "_cond",
+        "_admit_lock",
+        "_promote_lock",
+        "_dedup_lock",
+        "_trigger_lock",
+        "_call_lock",
+    }
+)
+
+#: Method names that are blocking wherever they appear.
+BLOCKING_METHOD_NAMES = frozenset(
+    {"wait_acked", "commit_barrier", "_tracked_restore",
+     "_rpc", "_node", "_peer", "result", "flush"}
+)
+
+#: Fully dotted calls that are blocking.
+BLOCKING_DOTTED = frozenset(
+    {
+        "os.fsync",
+        "time.sleep",
+        "subprocess.run",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "grpc.insecure_channel",
+    }
+)
+
+SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*(?P<checks>[a-z-]+(?:\s*,\s*[a-z-]+)*)\s*\)\s*"
+    r"(?::\s*(?P<reason>.*))?$"
+)
+
+
+@dataclass
+class Finding:
+    check: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class LintConfig:
+    """Knobs for testability: the seeded-violation fixtures inject tiny
+    registries instead of the real ones, and disable tree mode."""
+
+    #: declared fault points (None = parse ``tpubloom/faults``)
+    known_fault_points: Optional[frozenset] = None
+    #: declared metric names (None = parse ``tpubloom/obs/names.py``)
+    counters: Optional[frozenset] = None
+    gauges: Optional[frozenset] = None
+    #: run the cross-file tree checks (protocol coverage + reverse
+    #: registry checks) against ``repo_root``
+    tree_checks: bool = True
+    repo_root: Optional[str] = None
+    #: check names to skip entirely
+    disable: frozenset = field(default_factory=frozenset)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a call target ('self.mf.lock')."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return "?"
+
+
+# -- suppression handling -----------------------------------------------------
+
+
+class _Suppressions:
+    """Inline ``# lint: allow(check): reason`` comments for one file.
+    Parsed from real COMMENT tokens (``tokenize``), so a docstring that
+    merely *shows* the syntax is not a suppression."""
+
+    def __init__(self, path: str, source: str, findings: list):
+        import io
+        import tokenize
+
+        #: line -> {check -> reason}
+        self.by_line: dict = {}
+        self.used: set = set()
+        comments = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.string))
+        except tokenize.TokenizeError:  # pragma: no cover - parse already ran
+            pass
+        for lineno, text in comments:
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            checks = [c.strip() for c in m.group("checks").split(",")]
+            reason = (m.group("reason") or "").strip()
+            for check in checks:
+                if check not in CHECKS:
+                    findings.append(
+                        Finding(
+                            "unknown-suppression", path, lineno,
+                            f"allow({check}) names no known check "
+                            f"(known: {', '.join(CHECKS)})",
+                        )
+                    )
+                    continue
+                if not reason:
+                    findings.append(
+                        Finding(
+                            "suppression-reason", path, lineno,
+                            f"allow({check}) carries no reason — every "
+                            f"suppression must say why it is safe",
+                        )
+                    )
+                    continue
+                self.by_line.setdefault(lineno, {})[check] = reason
+
+    def matches(self, check: str, *lines: int) -> bool:
+        for line in lines:
+            if check in self.by_line.get(line, {}):
+                self.used.add((line, check))
+                return True
+        return False
+
+    def unused(self, path: str) -> list:
+        out = []
+        for line, checks in sorted(self.by_line.items()):
+            for check in checks:
+                if (line, check) not in self.used:
+                    out.append(
+                        Finding(
+                            "unused-suppression", path, line,
+                            f"allow({check}) matches no finding on this "
+                            f"line — remove it or fix the anchor",
+                        )
+                    )
+        return out
+
+
+# -- per-file checkers --------------------------------------------------------
+
+
+def _is_lock_with_item(item: ast.withitem) -> Optional[str]:
+    ctx = item.context_expr
+    if isinstance(ctx, ast.Attribute) and ctx.attr in LOCK_ATTRS:
+        return _dotted(ctx)
+    return None
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        recv = _dotted(func.value)
+        dotted = f"{recv}.{attr}"
+        if dotted in BLOCKING_DOTTED:
+            return f"{dotted}() blocks on IO/sleep"
+        if attr in BLOCKING_METHOD_NAMES:
+            return f"{dotted}() is a blocking call"
+        low = recv.lower()
+        if attr in ("wait", "wait_for") and (
+            "cond" in low or low.endswith("condition")
+        ):
+            has_timeout = any(k.arg == "timeout" for k in call.keywords)
+            n_args = len(call.args)
+            bounded = has_timeout or (
+                n_args >= (2 if attr == "wait_for" else 1)
+            )
+            if not bounded:
+                return f"{dotted}() waits without a timeout"
+            return None  # a bounded wait on the cond's own lock is fine
+        if attr == "close" and "checkpointer" in low:
+            return f"{dotted}() flushes + joins the checkpoint worker"
+        if attr == "restore" and ("ckpt" in low or "checkpoint" in low):
+            return f"{dotted}() reads checkpoint blobs from the sink"
+        if attr == "join" and any(
+            t in low for t in ("thread", "worker", "proc")
+        ):
+            return f"{dotted}() joins a thread"
+    elif isinstance(func, ast.Name) and func.id in ("fsync", "sleep"):
+        return f"{func.id}() blocks on IO/sleep"
+    return None
+
+
+class _FileVisitor(ast.NodeVisitor):
+    """Single pass per file: lock-region blocking calls, notify-vs-append
+    ordering, and literal fault/metric usage collection."""
+
+    def __init__(self, path: str, config: LintConfig):
+        self.path = path
+        self.config = config
+        self.findings: list = []
+        #: stack of (lock_expr, with_lineno) for enclosing lock withs
+        self._locks: list = []
+        #: per-function ordering state stack
+        self._funcs: list = []
+        #: (name, kind, line) literal metric emissions
+        self.metric_uses: list = []
+        #: (point, line) literal fault-point usages
+        self.fault_uses: list = []
+        #: every string constant in the file (reverse fault check)
+        self.str_constants: set = set()
+
+    # -- traversal ----------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lock = _is_lock_with_item(item)
+            if lock is not None:
+                self._locks.append((lock, node.lineno))
+                pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self._locks.pop()
+
+    def _visit_func(self, node) -> None:
+        self._funcs.append({"appends": [], "notifies": []})
+        # a nested function does not inherit the enclosing lock region:
+        # it runs when CALLED, not where it is defined
+        saved, self._locks = self._locks, []
+        self.generic_visit(node)
+        self._locks = saved
+        state = self._funcs.pop()
+        first_append = min(state["appends"], default=None)
+        for line in state["notifies"]:
+            if first_append is not None and line < first_append:
+                f = Finding(
+                    "notify-before-append", self.path, line,
+                    "notify_inserts before the op-log append: a "
+                    "checkpoint triggered by this batch would stamp a "
+                    "repl_seq that misses the batch's own record "
+                    "(crash-replay double-apply)",
+                )
+                if not self._suppressed(f):
+                    self.findings.append(f)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str):
+            self.str_constants.add(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_blocking(node)
+        self._collect_ordering(node)
+        self._collect_fault_use(node)
+        self._collect_metric_use(node)
+        self.generic_visit(node)
+
+    # -- checks -------------------------------------------------------------
+
+    def _suppressed(self, finding: Finding, extra_lines: Iterable[int] = ()) -> bool:
+        # resolved later, once the suppression table exists — buffer the
+        # candidate lines on the finding
+        finding._lines = (finding.line, *extra_lines)  # type: ignore[attr-defined]
+        return False
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        if not self._locks:
+            return
+        reason = _blocking_reason(node)
+        if reason is None:
+            return
+        lock, with_line = self._locks[-1]
+        f = Finding(
+            "blocking-under-lock", self.path, node.lineno,
+            f"{reason} while holding {lock!r} (with at line {with_line})",
+        )
+        self._suppressed(f, (with_line,))
+        self.findings.append(f)
+
+    def _collect_ordering(self, node: ast.Call) -> None:
+        if not self._funcs or not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        recv = _dotted(node.func.value).lower()
+        state = self._funcs[-1]
+        if attr in ("_log_op", "_log_create") or (
+            attr in ("append", "append_record") and "log" in recv
+        ):
+            state["appends"].append(node.lineno)
+        elif attr == "notify_inserts":
+            state["notifies"].append(node.lineno)
+
+    def _collect_fault_use(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in ("fire", "arm", "is_armed"):
+            return
+        recv = _dotted(node.func.value)
+        if "faults" not in recv:
+            return
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            self.fault_uses.append((node.args[0].value, node.lineno))
+
+    def _collect_metric_use(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        if attr not in ("incr", "count", "set_gauge"):
+            return
+        recv = _dotted(node.func.value).lower()
+        if attr == "incr" and "counter" not in recv:
+            return
+        if attr == "count" and "metrics" not in recv:
+            return
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return  # dynamic name: declared via DYNAMIC_PREFIXES instead
+        kind = "gauge" if attr == "set_gauge" else "counter"
+        self.metric_uses.append((node.args[0].value, kind, node.lineno))
+
+
+def _apply_registry_checks(
+    visitor: _FileVisitor, config: LintConfig
+) -> None:
+    """Turn collected fault/metric literal uses into findings against
+    the declared registries."""
+    if config.known_fault_points is not None:
+        known = config.known_fault_points
+        for point, line in visitor.fault_uses:
+            if point not in known:
+                f = Finding(
+                    "fault-registry", visitor.path, line,
+                    f"fault point {point!r} is not declared in "
+                    f"faults.KNOWN_POINTS — a typo'd chaos config would "
+                    f"silently inject nothing",
+                )
+                f._lines = (line,)  # type: ignore[attr-defined]
+                visitor.findings.append(f)
+    if config.counters is not None and config.gauges is not None:
+        for name, kind, line in visitor.metric_uses:
+            declared = config.counters if kind == "counter" else config.gauges
+            other = config.gauges if kind == "counter" else config.counters
+            if name in declared:
+                continue
+            if name in other:
+                msg = (
+                    f"metric {name!r} is emitted as a {kind} but declared "
+                    f"as the other kind in tpubloom.obs.names"
+                )
+            else:
+                msg = (
+                    f"metric {name!r} is not declared in tpubloom.obs.names "
+                    f"— every counter/gauge name is registered exactly once"
+                )
+            f = Finding("metric-registry", visitor.path, line, msg)
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
+
+
+def lint_file(path: str, config: LintConfig) -> tuple:
+    """Lint one file; returns (findings, visitor) — the visitor carries
+    the literal collections the tree checks aggregate."""
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    findings: list = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(
+            Finding("blocking-under-lock", path, e.lineno or 0,
+                    f"file does not parse: {e.msg}")
+        )
+        return findings, None
+    visitor = _FileVisitor(path, config)
+    visitor.visit(tree)
+    _apply_registry_checks(visitor, config)
+    sup = _Suppressions(path, source, findings)
+    for f in visitor.findings:
+        lines = getattr(f, "_lines", (f.line,))
+        if f.check in config.disable:
+            continue
+        if not sup.matches(f.check, *lines):
+            findings.append(f)
+    findings.extend(sup.unused(path))
+    return [f for f in findings if f.check not in config.disable], visitor
+
+
+# -- registry parsing (AST, no heavyweight imports) ---------------------------
+
+
+def _parse_string_collection(path: str, target_names: Iterable[str]) -> dict:
+    """``{name: [literals...]}`` for module-level assignments of string
+    tuples/sets/lists named in ``target_names`` (duplicates preserved)."""
+    out: dict = {}
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    wanted = set(target_names)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in wanted and isinstance(
+                node.value, (ast.Tuple, ast.Set, ast.List)
+            ):
+                out[t.id] = [
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+    return out
+
+
+def load_fault_points(repo_root: str) -> frozenset:
+    path = os.path.join(repo_root, "tpubloom", "faults", "__init__.py")
+    return frozenset(
+        _parse_string_collection(path, ("KNOWN_POINTS",)).get(
+            "KNOWN_POINTS", ()
+        )
+    )
+
+
+def load_metric_names(repo_root: str) -> tuple:
+    """(counters, gauges, duplicate-findings) from obs/names.py."""
+    path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    decls = _parse_string_collection(path, ("COUNTERS", "GAUGES"))
+    counters = decls.get("COUNTERS", [])
+    gauges = decls.get("GAUGES", [])
+    findings = []
+    for kind, names in (("COUNTERS", counters), ("GAUGES", gauges)):
+        seen: set = set()
+        for n in names:
+            if n in seen:
+                findings.append(
+                    Finding(
+                        "metric-registry", path, 0,
+                        f"{n!r} is declared twice in {kind} — registered "
+                        f"exactly once means once",
+                    )
+                )
+            seen.add(n)
+    for n in sorted(set(counters) & set(gauges)):
+        findings.append(
+            Finding(
+                "metric-registry", path, 0,
+                f"{n!r} is declared as both a counter and a gauge",
+            )
+        )
+    return frozenset(counters), frozenset(gauges), findings
+
+
+# -- tree checks --------------------------------------------------------------
+
+
+def _literal_set(path: str) -> set:
+    """Every string constant in a file (cheap containment probe)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    return {
+        n.value
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }
+
+
+def _service_handlers(path: str) -> tuple:
+    """(method defs on BloomService, keys of the stream behavior maps)."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    handlers: set = set()
+    behaviors: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "BloomService":
+            handlers = {
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in (
+                    "_STREAM_BEHAVIORS", "_CLIENT_STREAM_BEHAVIORS"
+                ):
+                    behaviors |= {
+                        k.value
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                    }
+    return handlers, behaviors
+
+
+def check_protocol_coverage(repo_root: str) -> list:
+    """Every METHODS entry: handler + client call + golden test; every
+    streaming method: behavior registration + golden test."""
+    proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
+    decls = _parse_string_collection(
+        proto_path, ("METHODS", "STREAM_METHODS", "CLIENT_STREAM_METHODS")
+    )
+    service_path = os.path.join(repo_root, "tpubloom", "server", "service.py")
+    client_path = os.path.join(repo_root, "tpubloom", "server", "client.py")
+    golden_path = os.path.join(repo_root, "tests", "test_protocol_golden.py")
+    handlers, behaviors = _service_handlers(service_path)
+    client_lits = _literal_set(client_path)
+    golden_lits = _literal_set(golden_path)
+    findings = []
+
+    def miss(method: str, what: str) -> None:
+        findings.append(
+            Finding(
+                "protocol-coverage", proto_path, 0,
+                f"protocol method {method!r} has no {what}",
+            )
+        )
+
+    for m in decls.get("METHODS", ()):
+        if m not in handlers:
+            miss(m, "BloomService handler (def in service.py)")
+        if m not in client_lits:
+            miss(m, "client call site (literal in client.py)")
+        if m not in golden_lits:
+            miss(m, "golden wire test (literal in test_protocol_golden.py)")
+    for m in list(decls.get("STREAM_METHODS", ())) + list(
+        decls.get("CLIENT_STREAM_METHODS", ())
+    ):
+        if m not in behaviors:
+            miss(m, "service behavior registration (_*_BEHAVIORS map)")
+        if m not in golden_lits:
+            miss(m, "golden wire test (literal in test_protocol_golden.py)")
+    return findings
+
+
+def iter_py_files(paths: Iterable[str]) -> list:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            out.extend(
+                os.path.join(root, fn) for fn in sorted(files)
+                if fn.endswith(".py")
+            )
+    return out
+
+
+def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> list:
+    config = config or LintConfig()
+    repo_root = config.repo_root or _repo_root()
+    findings: list = []
+    if config.known_fault_points is None:
+        config.known_fault_points = load_fault_points(repo_root)
+    if config.counters is None or config.gauges is None:
+        counters, gauges, dup_findings = load_metric_names(repo_root)
+        config.counters = counters
+        config.gauges = gauges
+        if config.tree_checks:
+            findings.extend(dup_findings)
+
+    fault_literal_seen: set = set()
+    metric_literal_seen: set = set()
+    fault_registry_path = os.path.join(
+        repo_root, "tpubloom", "faults", "__init__.py"
+    )
+    names_path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    for path in iter_py_files(paths):
+        file_findings, visitor = lint_file(path, config)
+        findings.extend(file_findings)
+        if visitor is None:
+            continue
+        if os.path.abspath(path) != os.path.abspath(fault_registry_path):
+            fault_literal_seen |= visitor.str_constants
+        if os.path.abspath(path) != os.path.abspath(names_path):
+            metric_literal_seen |= {n for n, _, _ in visitor.metric_uses}
+
+    if config.tree_checks:
+        findings.extend(check_protocol_coverage(repo_root))
+        for point in sorted(config.known_fault_points - fault_literal_seen):
+            findings.append(
+                Finding(
+                    "fault-registry", fault_registry_path, 0,
+                    f"declared fault point {point!r} is never referenced "
+                    f"outside the registry — dead vocabulary",
+                )
+            )
+        for name in sorted(
+            (config.counters | config.gauges) - metric_literal_seen
+        ):
+            findings.append(
+                Finding(
+                    "metric-registry", names_path, 0,
+                    f"declared metric {name!r} is never emitted in the "
+                    f"linted tree — stale catalog entry",
+                )
+            )
+    return [f for f in findings if f.check not in config.disable]
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpubloom.analysis.lint",
+        description="tpubloom project lint: concurrency + registry invariants",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to lint (default: the tpubloom package)",
+    )
+    parser.add_argument(
+        "--no-tree-checks", action="store_true",
+        help="skip the cross-file checks (protocol coverage, reverse "
+        "registry checks)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    repo_root = _repo_root()
+    paths = args.paths or [os.path.join(repo_root, "tpubloom")]
+    # expand once: iter_py_files passes plain files through, so the
+    # resolved list is also a valid `paths` for lint_paths
+    files = iter_py_files(paths)
+    config = LintConfig(tree_checks=not args.no_tree_checks)
+    findings = lint_paths(files, config)
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"tpubloom.analysis.lint: {len(findings)} finding(s) in "
+            f"{len(files)} file(s)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,7 @@ import numpy as np
 from tpubloom import faults
 from tpubloom.config import FilterConfig, identity_mismatch
 from tpubloom.obs import counters as _counters
+from tpubloom.utils import locks
 from tpubloom.utils.crc32c import crc32c
 
 log = logging.getLogger("tpubloom.checkpoint")
@@ -388,7 +389,7 @@ class RedisSink:
         from tpubloom.server.resp import RespClient
 
         self._client = RespClient(host, port, **kwargs)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("ckpt.redis_sink")
 
     def _index_key(self, key_name: str) -> str:
         return f"{key_name}:tpubloom.ckpt.seqs"
@@ -718,6 +719,12 @@ def restore(
     skipped: a wrong config must surface, not silently fall back to an
     older blob that happens to match.
     """
+    locks.note_blocking(
+        "ckpt.restore",
+        allow=("service.registry",),
+        reason="restore-on-create/promote IS the create's commit point and "
+        "must serialize under the registry lock; control-plane-rare",
+    )
     if seq is None and hasattr(sink, "list_seqs"):
         for s in sink.list_seqs(config.key_name):
             try:
@@ -862,7 +869,7 @@ class AsyncCheckpointer:
         self._seq = int(time.time() * 1000)
         self._queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._busy = threading.Event()
-        self._trigger_lock = threading.Lock()
+        self._trigger_lock = locks.named_lock("ckpt.trigger")
         self._stop = False
         self.last_error: Optional[Exception] = None
         self.checkpoints_written = 0
@@ -976,6 +983,13 @@ class AsyncCheckpointer:
         Returns False if it is still unfinished at ``timeout`` — callers
         treating a checkpoint as a durability point must check this.
         """
+        locks.note_blocking(
+            "ckpt.flush",
+            allow=("filter.op",),
+            reason="DropFilter/shutdown close under the op lock by design: "
+            "the final snapshot must exclude donating inserts, and the "
+            "filter is already unpublished so only stragglers contend",
+        )
         deadline = time.time() + timeout
         while self._busy.is_set() and time.time() < deadline:
             time.sleep(0.005)
@@ -990,6 +1004,13 @@ class AsyncCheckpointer:
         silently dropping the filter after a missed final write would lose
         the tail of the stream without anyone knowing.
         """
+        locks.note_blocking(
+            "ckpt.close",
+            allow=("filter.op",),
+            reason="DropFilter/shutdown close under the op lock by design: "
+            "the final snapshot must exclude donating inserts, and the "
+            "filter is already unpublished so only stragglers contend",
+        )
         ok = True
         if final_checkpoint:
             ok = self.flush()  # drain any in-flight write first

@@ -77,6 +77,7 @@ import threading
 from typing import Optional
 
 from tpubloom.obs import counters as _counters
+from tpubloom.utils import locks
 
 ENV_VAR = "TPUBLOOM_FAULTS"
 
@@ -104,7 +105,7 @@ KNOWN_POINTS = {
 
 MODES = ("raise", "torn")
 
-_lock = threading.Lock()
+_lock = locks.named_lock("faults.registry")
 _armed: dict[str, "_Fault"] = {}
 _env_loaded = False
 

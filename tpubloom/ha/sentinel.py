@@ -59,6 +59,7 @@ from tpubloom.ha.topology import Topology
 from tpubloom.obs import counters as _counters
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
+from tpubloom.utils import locks
 
 log = logging.getLogger("tpubloom.sentinel")
 
@@ -156,7 +157,7 @@ class Sentinel:
         self.failover_cooldown_s = failover_cooldown_s
         self.sentinel_id = sentinel_id or secrets.token_hex(8)
         self.topology = Topology(epoch=0, primary=watch, replicas=[])
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("sentinel.state")
         #: newest epoch this sentinel has VOTED in (self-votes included):
         #: one vote per epoch is the whole split-brain argument
         self._last_vote_epoch = 0
@@ -294,6 +295,7 @@ class Sentinel:
         req: dict,
         timeout: Optional[float] = None,
     ) -> dict:
+        locks.note_blocking("sentinel.rpc")
         raw = self._channel(address).unary_unary(
             path,
             request_serializer=lambda b: b,

@@ -14,12 +14,13 @@ import subprocess
 import threading
 
 import numpy as np
+from tpubloom.utils import locks
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "bloomhash.cpp")
 _LIB_PATH = os.path.join(_HERE, "libbloomhash.so")
 
-_lock = threading.Lock()
+_lock = locks.named_lock("native.build")
 _lib = None
 _load_failed = False  # negative cache: never re-fork a failing compiler
 HAS_NATIVE = False

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
+from tpubloom.utils import locks
 
-_lock = threading.Lock()
+_lock = locks.named_lock("obs.counters")
 _counters: dict[str, int] = defaultdict(int)
 _gauges: dict[str, float] = {}
 

@@ -1,0 +1,119 @@
+"""Central catalog of every counter/gauge name the process emits.
+
+Before ISSUE 6 the metric vocabulary lived wherever the ``incr``/
+``set_gauge``/``metrics.count`` call sites happened to be — a typo'd
+name minted a brand-new series nobody's dashboards watched, and a
+renamed one silently orphaned the old series. This catalog is the
+single declaration point: ``python -m tpubloom.analysis.lint`` verifies
+that every literal metric name used anywhere in ``tpubloom/`` is
+declared here EXACTLY ONCE (and in the right kind), and that every
+declared name is actually emitted somewhere — so the catalog can't rot
+into wishful documentation.
+
+Names built at runtime (per-fault, per-method, per-replica series)
+can't be checked literal-by-literal; their shapes are declared in
+:data:`DYNAMIC_PREFIXES` so the exposition layer and dashboards still
+have one place to look.
+
+Declaration rules the lint enforces:
+
+* a name appears in exactly one of :data:`COUNTERS` / :data:`GAUGES`;
+* every literal first argument to ``counters.incr``, ``metrics.count``
+  (counter kind) or ``counters.set_gauge`` (gauge kind) in
+  ``tpubloom/`` is declared under that kind;
+* every declared name has at least one emit site in ``tpubloom/``.
+"""
+
+from __future__ import annotations
+
+#: Monotone event counts (rendered as Prometheus ``counter``).
+COUNTERS = (
+    "breaker_closed",
+    "breaker_opened",
+    "ckpt_corrupt_detected",
+    "ckpt_quarantine_evicted",
+    "ckpt_restore_read_errors",
+    "client_primary_redirects",
+    "client_replica_fallbacks",
+    "client_topology_refreshes",
+    "delete_dedup_hits",
+    "faults_injected",
+    "filters_created",
+    "geometry_probe_demotions",
+    "ha_demotions",
+    "ha_promotions",
+    "ha_role_transitions",
+    "insert_dedup_hits",
+    "keys_deleted",
+    "keys_inserted",
+    "keys_queried",
+    "log_failstop_rejected",
+    "monitor_events_dropped",
+    "quorum_stale_acks",
+    "quorum_write_failures",
+    "quorum_writes_acked",
+    "readonly_rejected",
+    "repl_ack_decode_errors",
+    "repl_ack_stream_reopened",
+    "repl_acks_dropped",
+    "repl_acks_received",
+    "repl_acks_sent",
+    "repl_batched_frames_received",
+    "repl_bootstrap_partial_resyncs",
+    "repl_full_resyncs",
+    "repl_log_append_errors",
+    "repl_log_corrupt_dropped",
+    "repl_log_torn_tail_truncated",
+    "repl_log_truncations",
+    "repl_partial_resyncs",
+    "repl_reconnects",
+    "repl_records_applied",
+    "repl_records_reappended",
+    "repl_records_skipped",
+    "repl_records_streamed",
+    "repl_replay_applied",
+    "repl_snapshots_installed",
+    "repl_stream_batched_bytes_raw",
+    "repl_stream_batched_bytes_wire",
+    "repl_stream_batched_frames",
+    "repl_stream_cut_identity_rotated",
+    "requests_shed",
+    "restores_with_corrupt_generations",
+    "sentinel_failovers",
+    "sentinel_failovers_adopted",
+    "sentinel_fenced",
+    "sentinel_odown_agreed",
+    "sentinel_sdown_entered",
+    "sentinel_votes_granted",
+    "stale_epoch_rejected",
+)
+
+#: Last-write-wins levels (rendered as Prometheus ``gauge``).
+GAUGES = (
+    "client_breaker_state",
+    "ha_epoch",
+    "ha_role",
+    "monitor_subscribers",
+    "repl_connected_replicas",
+    "repl_lag_seconds",
+    "repl_lag_seq",
+    "repl_log_bytes",
+    "repl_log_segments",
+    "repl_log_seq",
+    "repl_max_replica_lag_seq",
+    "retry_after_ms_current",
+    "sentinel_known_replicas",
+    "sentinel_last_election_votes",
+    "sentinel_sdown",
+    "wait_blocked_current",
+)
+
+#: Shapes of names minted at runtime (not literal-checkable): the
+#: pattern, its kind, and where it comes from.
+DYNAMIC_PREFIXES = (
+    ("fault_", "counter", "per-point injection counts (tpubloom.faults)"),
+    ("stream_", "counter", "per-streaming-RPC open counts (service wrapper)"),
+)
+
+COUNTER_SET = frozenset(COUNTERS)
+GAUGE_SET = frozenset(GAUGES)

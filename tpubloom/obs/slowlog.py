@@ -22,6 +22,7 @@ import heapq
 import threading
 import time
 from typing import Optional
+from tpubloom.utils import locks
 
 
 def summarize_request(method: str, req: dict) -> str:
@@ -51,7 +52,7 @@ class Slowlog:
     def __init__(self, capacity: int = 128, threshold_s: float = 0.0):
         self.capacity = capacity
         self.threshold_s = threshold_s
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("obs.slowlog")
         self._heap: list[tuple[float, int, dict]] = []
         self._next_id = 0
         self.total_recorded = 0

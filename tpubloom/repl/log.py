@@ -39,6 +39,7 @@ from typing import Iterator, Optional
 from tpubloom import faults
 from tpubloom.obs import counters as _counters
 from tpubloom.repl import record as rec
+from tpubloom.utils import locks
 
 log = logging.getLogger("tpubloom.repl")
 
@@ -66,7 +67,7 @@ class OpLog:
         self.segment_bytes = segment_bytes
         self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
-        self._cond = threading.Condition()
+        self._cond = locks.named_condition("repl.oplog")
         self._fh = None
         self._size = 0
         self._bytes = 0
@@ -254,9 +255,9 @@ class OpLog:
             if self._fh is None or self._size >= self.segment_bytes:
                 self._roll(seq)
             self._fh.write(frame)
-            self._fh.flush()
+            self._fh.flush()  # lint: allow(blocking-under-lock): append IO under the log lock IS the commit protocol — readers may only ever observe whole records
             if self.fsync:
-                os.fsync(self._fh.fileno())
+                os.fsync(self._fh.fileno())  # lint: allow(blocking-under-lock): appendfsync-always parity — durability before visibility is the point of the flag
             self._size += len(frame)
             self._bytes += len(frame)
             self.last_seq = seq
@@ -286,9 +287,9 @@ class OpLog:
             if self._fh is None or self._size >= self.segment_bytes:
                 self._roll(seq)
             self._fh.write(frame)
-            self._fh.flush()
+            self._fh.flush()  # lint: allow(blocking-under-lock): append IO under the log lock IS the commit protocol — readers may only ever observe whole records
             if self.fsync:
-                os.fsync(self._fh.fileno())
+                os.fsync(self._fh.fileno())  # lint: allow(blocking-under-lock): appendfsync-always parity — durability before visibility is the point of the flag
             self._size += len(frame)
             self._bytes += len(frame)
             self.last_seq = seq

@@ -28,6 +28,7 @@ import time
 from typing import Optional
 
 from tpubloom.obs import counters as _counters
+from tpubloom.utils import locks
 
 #: Per-subscriber buffered events before drop-oldest kicks in.
 DEFAULT_QUEUE_DEPTH = 1024
@@ -38,7 +39,7 @@ class MonitorHub:
 
     def __init__(self, queue_depth: int = DEFAULT_QUEUE_DEPTH):
         self.queue_depth = queue_depth
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("repl.monitor_hub")
         self._ids = itertools.count()
         #: sub id -> (queue, name filter or None)
         self._subs: dict[int, tuple["queue.Queue", Optional[str]]] = {}

@@ -51,6 +51,7 @@ import msgpack
 
 from tpubloom import faults
 from tpubloom.obs import counters as _counters
+from tpubloom.utils import locks as _locks
 
 #: How often an idle stream emits a heartbeat (seconds).
 DEFAULT_HEARTBEAT_S = 0.5
@@ -70,7 +71,7 @@ class ReplicaSessions:
     gauges, and the wait-for-quorum primitive (ISSUE 5)."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = _locks.named_condition("repl.sessions")
         self._ids = itertools.count()
         self._sessions: dict[int, dict] = {}
         self._waiters = 0
@@ -90,6 +91,11 @@ class ReplicaSessions:
                 #: (via ReplAck) — what Wait/min-replicas block on; the
                 #: stream-side cursor only says what was SENT to it
                 "acked": 0,
+                #: monotonic time of the last ack FRAME (idle re-acks
+                #: refresh it) — the commit barrier's freshness gate
+                #: (ISSUE 6): an old-enough acked_at means the replica
+                #: stopped talking, and its acked cursor is history, not
+                #: durability
                 "acked_at": 0.0,
                 "connected_at": time.time(),
             }
@@ -116,20 +122,42 @@ class ReplicaSessions:
             sess = self._sessions.get(sid)
             if sess is None:
                 return  # stream already reconnected under a new sid
-            sess["acked_at"] = time.time()
-            if seq <= sess["acked"]:
-                return
-            sess["acked"] = seq
-            self._cond.notify_all()
+            sess["acked_at"] = time.monotonic()
+            if seq > sess["acked"]:
+                sess["acked"] = seq
+                self._cond.notify_all()
+            elif self._waiters:
+                # the seq did not advance but the FRESHNESS did (an idle
+                # re-ack): an age-gated quorum waiter may be satisfiable
+                # by exactly this refresh
+                self._cond.notify_all()
 
     def count(self) -> int:
         with self._cond:
             return len(self._sessions)
 
-    def count_acked(self, seq: int) -> int:
-        """Replicas whose acked cursor is at or past ``seq``."""
+    def _acked_locked(self, seq: int, max_age) -> int:
+        """Count under the condition: acked cursor at/past ``seq``, and —
+        with ``max_age`` (seconds) — an ack frame within that window.
+        Redis ``min-replicas-max-lag`` parity: lag is time since the
+        last REPLCONF ACK, so a replica that acked the seq long ago and
+        then went silent does not count toward a freshness-gated quorum."""
+        now = time.monotonic() if max_age is not None else 0.0
+        return sum(
+            1
+            for s in self._sessions.values()
+            if s["acked"] >= seq
+            and (max_age is None or now - s["acked_at"] <= max_age)
+        )
+
+    def count_acked(self, seq: int, *, max_age=None) -> int:
+        """Replicas whose acked cursor is at or past ``seq`` (optionally
+        only those whose last ack frame is ``max_age``-fresh; ``<= 0``
+        disables the gate, Redis ``min-replicas-max-lag 0`` parity)."""
+        if max_age is not None and max_age <= 0:
+            max_age = None
         with self._cond:
-            return sum(1 for s in self._sessions.values() if s["acked"] >= seq)
+            return self._acked_locked(seq, max_age)
 
     def wait_acked(
         self,
@@ -138,6 +166,7 @@ class ReplicaSessions:
         timeout: float,
         *,
         require_connected: int = 0,
+        max_age=None,
     ) -> int:
         """Block until at least ``needed`` replicas have acked ``seq``
         (or ``timeout`` elapses); returns the count actually acked —
@@ -152,19 +181,29 @@ class ReplicaSessions:
         current count immediately instead of sleeping out the timeout
         (``unregister`` wakes waiters exactly for this). The Wait RPC
         passes 0 — a replica may reconnect within its window, and Redis
-        WAIT rides out the full timeout."""
+        WAIT rides out the full timeout.
+
+        ``max_age`` (seconds, ISSUE 6) additionally requires each counted
+        replica's last ack FRAME to be that fresh — the commit barrier
+        passes its lag budget here so a replica that acked once and went
+        silent cannot keep satisfying durability quorums forever.
+        ``max_age <= 0`` means NO freshness gate (Redis
+        ``min-replicas-max-lag 0`` semantics: the check is disabled, not
+        infinitely strict — and a 0 gate would also busy-spin the
+        wait loop below)."""
+        _locks.note_blocking("repl.wait_acked")
+        if max_age is not None and max_age <= 0:
+            max_age = None
         deadline = time.monotonic() + max(0.0, timeout)
         with self._cond:
-            count = sum(1 for s in self._sessions.values() if s["acked"] >= seq)
+            count = self._acked_locked(seq, max_age)
             if needed <= 0 or count >= needed:
                 return count
             self._waiters += 1
             _counters.set_gauge("wait_blocked_current", self._waiters)
             try:
                 while True:
-                    count = sum(
-                        1 for s in self._sessions.values() if s["acked"] >= seq
-                    )
+                    count = self._acked_locked(seq, max_age)
                     remaining = deadline - time.monotonic()
                     if (
                         count >= needed
@@ -172,6 +211,11 @@ class ReplicaSessions:
                         or len(self._sessions) < require_connected
                     ):
                         return count
+                    # with an age gate, a quorum member can go STALE
+                    # mid-wait without any notify — cap the sleep so the
+                    # loop re-evaluates freshness on its own clock
+                    if max_age is not None:
+                        remaining = min(remaining, max_age / 2.0)
                     self._cond.wait(remaining)
             finally:
                 self._waiters -= 1

@@ -46,6 +46,7 @@ from tpubloom import faults
 from tpubloom.obs import counters as _counters
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
+from tpubloom.utils import locks
 
 log = logging.getLogger("tpubloom.repl")
 
@@ -172,7 +173,7 @@ class _AckSender:
     def __init__(self, channel, sid: int, *, reack_s: float = 0.5):
         self.sid = sid
         self.reack_s = reack_s
-        self._cond = threading.Condition()
+        self._cond = locks.named_condition("repl.ack_sender")
         self._seq: Optional[int] = None
         self._sent: Optional[int] = None
         self._closed = False
@@ -271,7 +272,7 @@ class ReplicaApplier:
         self._since_persist = 0
         self._stop = threading.Event()
         self._call = None
-        self._call_lock = threading.Lock()
+        self._call_lock = locks.named_lock("repl.applier_call")
         #: live ReplAck sender (sync-repl, ISSUE 5); rebuilt per sync
         self._ack: Optional[_AckSender] = None
         self._channel = None
@@ -452,6 +453,12 @@ class ReplicaApplier:
                 # cursors full-resync too (their state reset with ours)
                 self.service.oplog.reset_to(self.cursor)
             self._adopt_epoch(msg)
+            # gauge before link flips: wait_caught_up gates on
+            # link == "connected", and callers read repl_lag_seq the
+            # moment it returns — _start_ack below can take a while
+            _counters.set_gauge(
+                "repl_lag_seq", max(0, self.head_seq - (self.cursor or 0))
+            )
             self.link = "connected"
             self._persist_cursor(force=True)
             self._start_ack(msg)
@@ -461,6 +468,9 @@ class ReplicaApplier:
             self.cursor = msg["cursor"]
             self.log_id = msg.get("log_id")
             self._adopt_epoch(msg)
+            _counters.set_gauge(
+                "repl_lag_seq", max(0, self.head_seq - (self.cursor or 0))
+            )
             self.link = "connected"
             self._persist_cursor(force=True)
             self._start_ack(msg)
@@ -544,8 +554,14 @@ class ReplicaApplier:
         else:
             self.records_skipped += 1
             _counters.incr("repl_records_skipped")
-        self.cursor = rec["seq"]
         self.head_seq = max(self.head_seq, rec["seq"])
+        # gauge BEFORE the cursor advance: wait_caught_up polls the
+        # cursor from another thread, and callers assert repl_lag_seq
+        # the moment it flips — the gauge must already agree
+        _counters.set_gauge(
+            "repl_lag_seq", max(0, self.head_seq - rec["seq"])
+        )
+        self.cursor = rec["seq"]
         ack = self._ack
         if ack is not None:
             ack.update(rec["seq"])

@@ -87,6 +87,7 @@ import numpy as np
 from tpubloom.obs import counters as obs_counters
 from tpubloom.obs.context import new_rid
 from tpubloom.server import protocol
+from tpubloom.utils import locks
 
 #: error codes meaning "the server refused BEFORE running the handler" —
 #: replaying is safe for every method, idempotent or not
@@ -156,7 +157,7 @@ class CircuitBreaker:
         self._state = "closed"
         self._opened_at = 0.0
         self._half_open_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("client.breaker")
         obs_counters.set_gauge("client_breaker_state", 0)
 
     @property
@@ -442,6 +443,7 @@ class BloomClient:
         # DeleteBatch and non-idempotent InsertBatch retries lean on this
         # id: the server's dedup cache answers a replayed rid from cache
         # instead of re-applying.
+        locks.note_blocking("client.rpc")
         self.last_rid = rid = new_rid()
         req = {**req, "rid": rid}
         if self.epoch is not None and method in protocol.MUTATING_METHODS:

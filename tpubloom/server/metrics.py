@@ -19,6 +19,7 @@ import threading
 import time
 from collections import defaultdict
 from typing import Optional
+from tpubloom.utils import locks
 
 
 class LatencyHistogram:
@@ -76,7 +77,7 @@ class Metrics:
     """Process-wide counters + per-RPC latency and phase histograms."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("obs.metrics")
         self.counters: dict[str, int] = defaultdict(int)
         self.latency: dict[str, LatencyHistogram] = defaultdict(LatencyHistogram)
         #: "<method>/<phase>" -> histogram (same buckets as latency)

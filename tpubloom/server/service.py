@@ -134,7 +134,7 @@ from tpubloom.repl import primary as repl_primary
 from tpubloom.repl.replica import FullResyncNeeded
 from tpubloom.server import protocol
 from tpubloom.server.metrics import Metrics
-from tpubloom.utils import tracing
+from tpubloom.utils import locks, tracing
 
 log = logging.getLogger("tpubloom.server")
 
@@ -144,7 +144,7 @@ class _Managed:
         import inspect
 
         self.filter = filt
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("filter.op")
         #: newest op-log seq whose effect this filter's state contains —
         #: advanced at every logged commit, persisted into checkpoint
         #: headers (``repl_seq``), and used to gate replay/stream apply
@@ -242,14 +242,14 @@ class BloomService:
         ``NOT_ENOUGH_REPLICAS`` (Redis ``NOREPLICAS``). Requests may
         demand a STRONGER per-call quorum via ``min_replicas``."""
         self._filters: dict[str, _Managed] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("service.registry")
         self._sink_factory = sink_factory or (lambda config: None)
         self.metrics = Metrics()
         self.slowlog = Slowlog(capacity=slowlog_capacity)
         self.max_in_flight = max_in_flight
         self.retry_after_ms = retry_after_ms
         self._in_flight = 0
-        self._admit_lock = threading.Lock()
+        self._admit_lock = locks.named_lock("service.admit")
         self._draining = False
         self._last_shed_time = 0.0
         #: decaying shed-rate pressure (events, half-life ~PRESSURE_DECAY_S)
@@ -258,7 +258,7 @@ class BloomService:
         self._pressure_updated = time.monotonic()
         self._dedup_capacity = dedup_capacity
         self._dedup: "OrderedDict[str, dict]" = OrderedDict()
-        self._dedup_lock = threading.Lock()
+        self._dedup_lock = locks.named_lock("service.dedup")
         #: filter name -> time a corrupt checkpoint was detected during its
         #: restore; cleared once a good checkpoint lands after that moment
         self._ckpt_corrupt_seen: dict[str, float] = {}
@@ -301,7 +301,7 @@ class BloomService:
         obs_counters.set_gauge("ha_epoch", float(self.epoch))
         obs_counters.set_gauge("ha_role", 1.0 if read_only else 0.0)
         #: serializes role transitions (Promote / ReplicaOf)
-        self._promote_lock = threading.Lock()
+        self._promote_lock = locks.named_lock("service.promote")
         #: where the creation manifest lives (the op log dir on nodes
         #: with a log; a replica's durable state dir otherwise)
         self._manifest_dir: Optional[str] = (
@@ -443,7 +443,13 @@ class BloomService:
             return resp
         timeout_ms = req.get("min_replicas_timeout_ms")
         if timeout_ms is None:  # explicit 0 = probe: fail unless already acked
-            timeout_ms = self.min_replicas_max_lag_ms
+            # the lag budget doubles as the default wait budget — but a
+            # budget of 0 means the freshness gate is DISABLED (Redis
+            # min-replicas-max-lag 0), not "probe every write": fall
+            # back to the stock budget so quorum writes still wait
+            timeout_ms = (
+                self.min_replicas_max_lag_ms or DEFAULT_MIN_REPLICAS_MAX_LAG_MS
+            )
         timeout_ms = int(timeout_ms)
         connected = self.repl_sessions.count()
         if connected < needed:
@@ -460,18 +466,33 @@ class BloomService:
                          "connected": connected, "applied": True},
             )
         t0 = time.perf_counter()
+        # freshness gate (ISSUE 6, Redis min-replicas-max-lag parity):
+        # a replica only counts toward the quorum while its last ack
+        # FRAME is within the lag budget — an acked-then-silent replica
+        # is history, not durability. The barrier runs outside every
+        # lock (note_blocking in wait_acked enforces that at runtime).
+        max_age_s = self.min_replicas_max_lag_ms / 1000.0
         acked = self.repl_sessions.wait_acked(
-            seq, needed, timeout_ms / 1000.0, require_connected=needed
+            seq, needed, timeout_ms / 1000.0, require_connected=needed,
+            max_age=max_age_s,
         )
         self.metrics.observe_wait(time.perf_counter() - t0)
         if acked < needed:
             self._quorum_failed(needed, acked)
+            details = {"acked": acked, "needed": needed, "seq": seq,
+                       "timeout_ms": timeout_ms, "applied": True}
+            stale = self.repl_sessions.count_acked(seq) - acked
+            if stale > 0:
+                # the seq IS acked somewhere, just not freshly — name
+                # the distinction so operators chase the silent replica,
+                # not a replication gap
+                self.metrics.count("quorum_stale_acks", stale)
+                details["stale_acks"] = stale
             raise protocol.BloomServiceError(
                 "NOT_ENOUGH_REPLICAS",
-                f"only {acked}/{needed} replica(s) acked seq {seq} "
+                f"only {acked}/{needed} replica(s) freshly acked seq {seq} "
                 f"within {timeout_ms}ms",
-                details={"acked": acked, "needed": needed, "seq": seq,
-                         "timeout_ms": timeout_ms, "applied": True},
+                details=details,
             )
         self.metrics.count("quorum_writes_acked")
         resp["acked_replicas"] = acked
@@ -1060,7 +1081,7 @@ class BloomService:
             restored = None
             if sink is not None and req.get("restore", True):
                 try:
-                    restored = self._tracked_restore(
+                    restored = self._tracked_restore(  # lint: allow(blocking-under-lock): create/drop commit points must serialize under the registry lock, and restore-on-create IS this create's commit; creates are control-plane-rare
                         name, config, sink, expect_scalable=False
                     )
                 except ValueError as e:
@@ -1285,7 +1306,7 @@ class BloomService:
         if mf.checkpointer:
             final = req.get("final_checkpoint", True)
             with mf.lock:  # exclude donating inserts during the final snapshot
-                landed = mf.checkpointer.close(final_checkpoint=final)
+                landed = mf.checkpointer.close(final_checkpoint=final)  # lint: allow(blocking-under-lock): the filter is already unpublished from the registry — only straggler in-flight RPCs contend, and they must not donate mid-snapshot
             if final and not landed:
                 # the filter is gone from memory either way — the caller
                 # asked for a durability point and must know it was missed
@@ -1529,7 +1550,7 @@ class BloomService:
         for name, mf in filters:
             if mf.checkpointer:
                 with mf.lock:  # let in-flight inserts drain first
-                    landed = mf.checkpointer.close(final_checkpoint=True)
+                    landed = mf.checkpointer.close(final_checkpoint=True)  # lint: allow(blocking-under-lock): shutdown path — admission is already draining, the final snapshot must exclude donating inserts
                 if not landed:
                     log.error(
                         "final checkpoint for filter %r did not land: %r",

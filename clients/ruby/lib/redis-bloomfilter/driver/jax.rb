@@ -261,8 +261,10 @@ class Redis
           no_retry ||= method == "InsertBatch" && counting?
           retries = no_retry ? 0 : @max_retries
           # one rid per LOGICAL call — retries and the NOT_FOUND heal's
-          # final retry reuse it; the server's DeleteBatch dedup keys on it
-          payload = payload.merge("rid" => SecureRandom.hex(8))
+          # final retry reuse it; the server's DeleteBatch dedup keys on
+          # it. A caller-provided rid wins (the cluster driver stamps one
+          # BEFORE delegating here so its redirect/re-drive hops share it)
+          payload = payload.merge("rid" => payload["rid"] || SecureRandom.hex(8))
           attempt = 0
           shed_attempt = 0
           recreated = false

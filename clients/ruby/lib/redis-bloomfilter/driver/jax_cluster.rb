@@ -1,0 +1,189 @@
+# Driver::JaxCluster — the cluster-aware :jax driver (ISSUE 9).
+#
+# Same duck-typed contract as Driver::Jax (#insert, #include?, #delete,
+# #clear, the batch surface), but against a tpubloom CLUSTER: the filter
+# name hashes to one of 16384 slots (CRC16-XMODEM mod 16384, Redis
+# Cluster's exact function, {hash tag} extraction included), the driver
+# bootstraps the slot→node map from any node's ClusterSlots answer, and
+# the two redirect kinds heal transparently:
+#
+#   MOVED <slot> <addr> — ownership changed (a finalized migration or a
+#     stale map): re-fetch the map, reconnect to the new owner, retry;
+#   ASK <slot> <addr>   — slot mid-migration and the filter already
+#     lives at the target: ONE follow-up flagged "asking" goes to the
+#     target, no map update (the source still owns the slot).
+#
+# MIGRATE_FORWARD_FAILED (the write applied on the source but its
+# dual-write forward is still in flight) is re-driven under the SAME
+# rid — the server answers the replay from its dedup cache and forwards
+# again, so counting filters never double-apply.
+#
+# opts adds to Driver::Jax's:
+#   :cluster_nodes - ["host:port", ...] of any cluster nodes (the map
+#                    bootstrap set; the live owner is resolved per the
+#                    map, so this list only needs one reachable node)
+#
+# NOTE: written against the documented server protocol but UNTESTED in
+# the build environment (no Ruby toolchain in the image); the identical
+# wire format and redirect flow is exercised end-to-end by the Python
+# ClusterClient (tests/test_cluster.py).
+
+require_relative "jax"
+
+class Redis
+  class Bloomfilter
+    module Driver
+      class JaxCluster < Jax
+        NUM_SLOTS = 16_384
+
+        CRC16_TABLE = (0...256).map do |byte|
+          crc = byte << 8
+          8.times do
+            crc = ((crc & 0x8000).zero? ? crc << 1 : (crc << 1) ^ 0x1021) & 0xFFFF
+          end
+          crc
+        end.freeze
+
+        def self.crc16(data)
+          crc = 0
+          data.each_byte do |b|
+            crc = ((crc << 8) & 0xFFFF) ^ CRC16_TABLE[((crc >> 8) ^ b) & 0xFF]
+          end
+          crc
+        end
+
+        # Redis hash-tag rule: a non-empty {...} body hashes alone, so
+        # user:{42}:seen and user:{42}:blocked share a slot.
+        def self.key_slot(name)
+          raw = name.to_s.b
+          if (start = raw.index("{")) && (stop = raw.index("}", start + 1)) &&
+             stop > start + 1
+            raw = raw[(start + 1)...stop]
+          end
+          crc16(raw) % NUM_SLOTS
+        end
+
+        def initialize(opts = {})
+          @cluster_nodes = Array(opts[:cluster_nodes])
+          raise ArgumentError, "need :cluster_nodes" if @cluster_nodes.empty?
+          @slot = self.class.key_slot(opts[:key_name] || "tpubloom")
+          owner = resolve_owner || @cluster_nodes.first
+          super(opts.merge(address: owner))
+        end
+
+        private
+
+        # The freshest ClusterSlots answer across the bootstrap nodes;
+        # returns our slot's owner address (nil when no node answers).
+        def resolve_owner
+          best = nil
+          @cluster_nodes.each do |addr|
+            stub = GRPC::ClientStub.new(addr, :this_channel_is_insecure)
+            begin
+              raw = stub.request_response(
+                "/#{SERVICE}/ClusterSlots", {}.to_msgpack, IDENTITY, IDENTITY
+              )
+              resp = MessagePack.unpack(raw)
+              next unless resp["ok"] && resp["enabled"]
+              best = resp if best.nil? || resp["epoch"].to_i > best["epoch"].to_i
+            rescue GRPC::BadStatus
+              next
+            end
+          end
+          return nil unless best
+          (best["ranges"] || []).each do |start, stop, addr|
+            return addr if @slot.between?(start, stop)
+          end
+          nil
+        end
+
+        # Layer the cluster redirects over Jax#rpc's retry machinery
+        # (shed pacing, UNAVAILABLE backoff, NOT_FOUND heal all apply
+        # per target node).
+        def rpc(method, payload, no_retry: false)
+          # stamp the logical call's rid HERE so every hop below — the
+          # base driver's retries, ASK follow-ups, and forward re-drives
+          # — shares it (the server's dedup cache keys on it; a fresh
+          # rid per hop would double-apply counting inserts)
+          payload = payload.merge("rid" => SecureRandom.hex(8))
+          redirects = 0
+          begin
+            super
+          rescue ServiceError => e
+            case e.code
+            when "MOVED"
+              raise if redirects >= 5
+              redirects += 1
+              connect(e.details["addr"] || resolve_owner)
+              retry
+            when "ASK"
+              ask_once(method, payload, e.details["addr"])
+            when "CLUSTERDOWN"
+              raise if redirects >= 5
+              redirects += 1
+              owner = resolve_owner
+              connect(owner) if owner
+              sleep(0.1 * redirects)
+              retry
+            when "MIGRATE_FORWARD_FAILED"
+              # applied on the source, forward pending: re-drive the
+              # SAME rid until the dual-write lands (dedup-safe); the
+              # error's src_seq rides along so a post-finalize MOVED
+              # follow-up is still judged by the new owner's import
+              # gate (a record the snapshot contains must dup out)
+              redrive(method, payload, e.details["src_seq"])
+            else
+              raise
+            end
+          end
+        end
+
+        # One ASKING follow-up at the migration target (Redis ASK
+        # semantics: no map update, the source still owns the slot).
+        def ask_once(method, payload, addr, src_seq = nil)
+          stub = GRPC::ClientStub.new(addr, :this_channel_is_insecure)
+          followup = payload.merge("asking" => true)
+          followup["src_seq"] = src_seq if src_seq
+          raw = stub.request_response(
+            "/#{SERVICE}/#{method}",
+            followup.to_msgpack,
+            IDENTITY,
+            IDENTITY
+          )
+          resp = MessagePack.unpack(raw)
+          unless resp["ok"]
+            err = resp["error"] || {}
+            raise ServiceError.new(
+              err["code"] || "UNKNOWN", err["message"], err["details"]
+            )
+          end
+          resp
+        end
+
+        def redrive(method, payload, src_seq = nil)
+          30.times do |i|
+            sleep([0.05 * (i + 1), 1.0].min)
+            begin
+              return rpc_once(method, payload)
+            rescue ServiceError => e
+              case e.code
+              when "MIGRATE_FORWARD_FAILED"
+                src_seq = e.details["src_seq"] || src_seq
+                next
+              when "MOVED", "ASK"
+                return ask_once(method, payload, e.details["addr"], src_seq)
+              else
+                raise
+              end
+            rescue GRPC::BadStatus
+              next
+            end
+          end
+          raise ServiceError.new(
+            "MIGRATE_FORWARD_FAILED", "re-drive budget exhausted", {}
+          )
+        end
+      end
+    end
+  end
+end

@@ -522,3 +522,76 @@ def test_subprocess_exit_report(tmp_path):
     kinds = [v["kind"] for v in rep["violations"]]
     assert kinds == ["lock-order-cycle"]
     assert "violation(s)" in proc.stderr  # printed to stderr too
+
+
+# -- lock-order manifest (ISSUE 9 satellite, ROADMAP item 7) ------------------
+
+
+def test_lock_order_manifest_diff():
+    """An edge outside the declared manifest is a finding; declared
+    edges are clean — the "new nesting is a reviewed decision" gate."""
+    from tpubloom.analysis import lock_order
+
+    assert lock_order.diff_edges([("filter.op", "repl.oplog")]) == []
+    findings = lock_order.diff_edges(
+        [("filter.op", "repl.oplog"), ("repl.oplog", "filter.op")]
+    )
+    assert len(findings) == 1
+    assert findings[0]["kind"] == "undeclared-lock-edge"
+    assert findings[0]["edge"] == ["repl.oplog", "filter.op"]
+    # the report-dict form (the lockcheck-<pid>.json shape)
+    report = {
+        "edges": [
+            {"from": "service.registry", "to": "repl.oplog", "count": 3},
+            {"from": "obs.counters", "to": "service.registry", "count": 1},
+        ],
+        "violations": [],
+        "suppressed": [],
+    }
+    findings = lock_order.check_report(report)
+    assert [f["edge"] for f in findings] == [
+        ["obs.counters", "service.registry"]
+    ]
+
+
+def test_lock_order_manifest_covers_cluster_ranks():
+    """The ISSUE-9 seeding: the cluster lock class participates in the
+    manifest — migration snapshots arm the dual-write under the filter
+    lock, and cluster.state is otherwise a leaf."""
+    from tpubloom.analysis import lock_order
+
+    assert ("filter.op", "cluster.state") in lock_order.ALLOWED_EDGES
+    assert ("cluster.state", "obs.counters") in lock_order.ALLOWED_EDGES
+    # nothing is declared acquirable under cluster.state except the
+    # counters bookkeeping — node→node RPCs must run lock-free
+    inners = {
+        inner for outer, inner in lock_order.ALLOWED_EDGES
+        if outer == "cluster.state"
+    }
+    assert inners == {"obs.counters"}
+
+
+def test_lock_order_cli(tmp_path, capsys):
+    from tpubloom.analysis import lock_order
+
+    clean = tmp_path / "lockcheck-1.json"
+    clean.write_text(json.dumps({
+        "edges": [{"from": "filter.op", "to": "repl.oplog", "count": 1}],
+        "violations": [], "suppressed": [],
+    }))
+    assert lock_order.main([str(tmp_path)]) == 0
+    capsys.readouterr()
+
+    dirty = tmp_path / "lockcheck-2.json"
+    dirty.write_text(json.dumps({
+        "edges": [{"from": "repl.oplog", "to": "service.registry",
+                   "count": 1}],
+        "violations": [], "suppressed": [],
+    }))
+    assert lock_order.main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "undeclared-lock-edge" in out and "repl.oplog" in out
+
+    assert lock_order.main(["--list"]) == 0
+    listed = capsys.readouterr().out
+    assert "filter.op -> repl.oplog" in listed

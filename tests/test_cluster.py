@@ -1,0 +1,870 @@
+"""Cluster-mode suite (ISSUE 9).
+
+Layers covered:
+
+* slot hashing — CRC16-XMODEM vectors, hash tags, range compression,
+  CRC-checked map persistence (corruption reads as "no map");
+* ownership checks — MOVED/ASK/CLUSTERDOWN shapes, importing-side
+  ``asking`` discipline, config-epoch rejection of stale assignments;
+* the routed client — slot cache bootstrap, MOVED healing after an
+  out-of-band ownership flip, hash-tag colocation;
+* live migration — under concurrent client load, counting filters,
+  exactly-once proof (every acked key present at the new owner, ONE
+  delete round empties them), dual-write forward + import-gate dedup,
+  epoch bump, source answering MOVED after the handoff;
+* migration resume — an injected mid-migration crash + re-drive takes
+  the op-log-tail path (no blob resend) and stays exactly-once;
+* the acceptance chaos story — a real subprocess source is SIGKILLed
+  mid-migration under load, restarted, and the re-driven migration
+  finishes with zero lost / zero doubled acked writes
+  (``test_migration_sigkill_acceptance``);
+* satellites — sentinel ``TopologyEvents`` push (client re-points
+  without an error round trip), ``tpubloom.obs.aggregate`` cross-node
+  scrape merge, histogram exemplars linking latency buckets to slowlog
+  rids, the rebalancer's planning, and the lock-order manifest diff
+  (module teardown asserts every runtime acquisition edge this suite
+  drives is DECLARED in ``tpubloom/analysis/lock_order.py``).
+"""
+
+import json
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from tpubloom import faults
+from tpubloom.cluster import slots as S
+from tpubloom.cluster.client import ClusterClient
+from tpubloom.cluster.node import ClusterState
+from tpubloom.cluster.rebalance import even_ranges, plan_moves
+from tpubloom.obs import counters as obs_counters
+from tpubloom.repl import OpLog
+from tpubloom.server import protocol
+from tpubloom.server.client import BloomClient
+from tpubloom.server.protocol import BloomServiceError
+from tpubloom.server.service import BloomService, build_server
+
+# ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
+# module (asserted violation-free at teardown — tests/conftest.py).
+pytestmark = pytest.mark.usefixtures("lock_check_armed")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lock_order_manifest(lock_check_armed):
+    """ISSUE 9 satellite (ROADMAP item 7): after the whole armed module
+    ran, every acquisition edge in the runtime graph — in-process AND
+    the subprocess exit reports — must be DECLARED in the lock-order
+    manifest. A new edge is a finding: new lock nesting is a reviewed
+    design decision, not an accident."""
+    from tpubloom.analysis import lock_order
+    from tpubloom.utils import locks
+
+    yield
+    findings = lock_order.check_live()
+    report_dir = os.environ.get(locks.REPORT_DIR_ENV, "")
+    if report_dir and os.path.isdir(report_dir):
+        import glob as _glob
+
+        for path in sorted(
+            _glob.glob(os.path.join(report_dir, "lockcheck-*.json"))
+        ):
+            with open(path) as f:
+                findings.extend(
+                    {**v, "report": os.path.basename(path)}
+                    for v in lock_order.check_report(json.load(f))
+                )
+    assert not findings, (
+        "undeclared lock-order edges (declare deliberately in "
+        "tpubloom/analysis/lock_order.py or fix the nesting):\n"
+        + "\n".join(f"  {f['message']}" for f in findings)
+    )
+
+
+def _wait(pred, timeout=30.0, poll=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _node(tmp_path, name, *, sink=False):
+    """In-process cluster-enabled primary (op log + slot map persisted
+    in the log dir)."""
+    from tpubloom import checkpoint as ckpt
+
+    d = tmp_path / name
+    oplog = OpLog(str(d / "log"))
+    svc = BloomService(
+        sink_factory=(
+            (lambda config: ckpt.FileSink(str(d / "ck"))) if sink else None
+        ),
+        oplog=oplog,
+    )
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    addr = f"127.0.0.1:{port}"
+    svc.listen_address = addr
+    svc.cluster = ClusterState(addr, state_dir=str(d / "log"))
+    return svc, srv, addr, oplog
+
+
+def _teardown(*nodes):
+    for svc, srv, _addr, oplog in nodes:
+        srv.stop(grace=None)
+        oplog.close()
+        if svc.cluster is not None:
+            svc.cluster.close()
+
+
+def _assign_even(nodes):
+    addrs = [n[2] for n in nodes]
+    ranges = even_ranges(addrs)
+    for svc, _srv, _addr, _ in nodes:
+        svc.ClusterSetSlot({"assign": ranges, "epoch": 1})
+    return addrs
+
+
+def _name_owned_by(owners_fn, addr, prefix="f"):
+    for i in range(4096):
+        cand = f"{prefix}-{i}"
+        if owners_fn(S.key_slot(cand)) == addr:
+            return cand
+    raise AssertionError("no candidate name hashed to the wanted node")
+
+
+# -- slot hashing + map ------------------------------------------------------
+
+
+def test_crc16_and_key_slot_vectors():
+    # the classic CRC16-XMODEM check value — the polynomial Redis uses
+    assert S.crc16(b"123456789") == 0x31C3
+    assert 0 <= S.key_slot("foo") < S.NUM_SLOTS
+    assert S.key_slot("foo") == S.crc16(b"foo") % S.NUM_SLOTS
+    # hash tags: a non-empty {...} body hashes alone (Redis rule)
+    assert S.key_slot("user:{42}:seen") == S.key_slot("user:{42}:blocked")
+    assert S.key_slot("user:{42}:seen") == S.key_slot("42")
+    # empty tag and no tag hash the whole name
+    assert S.key_slot("{}x") == S.crc16(b"{}x") % S.NUM_SLOTS
+    assert S.key_slot(b"bytes-too") == S.crc16(b"bytes-too") % S.NUM_SLOTS
+
+
+def test_ranges_roundtrip_and_store(tmp_path):
+    owners = {0: "a", 1: "a", 2: "b", 4: "a", 5: "a"}
+    r = S.ranges_of(owners)
+    assert r == [[0, 1, "a"], [2, 2, "b"], [4, 5, "a"]]
+    assert S.expand_ranges(r) == owners
+
+    m = S.SlotMap()
+    m.adopt_assignments(r, 3)
+    m.migrating[2] = "c"
+    store = S.SlotStore(str(tmp_path))
+    store.store(m)
+    loaded = S.SlotStore(str(tmp_path)).load()
+    assert loaded.epoch == 3 and loaded.owners == owners
+    assert loaded.migrating == {2: "c"}
+    # corruption reads as "no map" (CLUSTERDOWN until re-pushed), never
+    # a crash and never the wrong shard's keys
+    with open(store.path, "a") as f:
+        f.write("rot")
+    assert S.SlotStore(str(tmp_path)).load() is None
+
+
+def test_slot_map_epoch_discipline(tmp_path):
+    m = S.SlotMap()
+    assert m.adopt_assignments([[0, 10, "a"]], 5)
+    assert not m.adopt_assignments([[0, 10, "b"]], 4)  # stale push
+    assert m.owner(3) == "a"
+
+    state = ClusterState("a", state_dir=str(tmp_path))
+    state.set_slot({"assign": [[0, S.NUM_SLOTS - 1, "a"]], "epoch": 5})
+    with pytest.raises(BloomServiceError, match="STALE_EPOCH"):
+        state.set_slot({"assign": [[0, 10, "b"]], "epoch": 4})
+    with pytest.raises(BloomServiceError, match="STALE_EPOCH"):
+        state.set_slot({"slot": 1, "state": "node", "addr": "b", "epoch": 2})
+    state.set_slot({"slot": 1, "state": "node", "addr": "b", "epoch": 6})
+    assert state.owner(1) == "b" and state.epoch() == 6
+
+
+# -- ownership checks --------------------------------------------------------
+
+
+def test_moved_ask_clusterdown_shapes(tmp_path):
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        # no assignment yet: every keyed call is CLUSTERDOWN
+        ca = BloomClient(a[2])
+        with pytest.raises(BloomServiceError, match="CLUSTERDOWN"):
+            ca.create_filter("pre-map", capacity=1000, error_rate=0.01)
+
+        addrs = _assign_even((a, b))
+        name_b = _name_owned_by(a[0].cluster.owner, addrs[1], prefix="onb")
+        slot_b = S.key_slot(name_b)
+        # node a does not own name_b's slot: MOVED with machine-readable
+        # slot + addr (what clients re-route from)
+        try:
+            ca.create_filter(name_b, capacity=1000, error_rate=0.01)
+            raise AssertionError("expected MOVED")
+        except BloomServiceError as e:
+            assert e.code == "MOVED"
+            assert e.details["slot"] == slot_b
+            assert e.details["addr"] == addrs[1]
+
+        # importing side only serves asking-flagged requests: park
+        # slot_b's OWNERSHIP on a (both views) so b is purely importing
+        for svc in (a[0], b[0]):
+            svc.ClusterSetSlot(
+                {"slot": slot_b, "state": "node", "addr": addrs[0],
+                 "epoch": 2}
+            )
+        b[0].ClusterSetSlot(
+            {"slot": slot_b, "state": "importing", "addr": addrs[0]}
+        )
+        cb = BloomClient(b[2])
+        with pytest.raises(BloomServiceError, match="MOVED"):
+            cb._rpc("CreateFilter",
+                    {"name": name_b, "capacity": 1000, "error_rate": 0.01})
+        assert cb._rpc(
+            "CreateFilter",
+            {"name": name_b, "capacity": 1000, "error_rate": 0.01,
+             "asking": True},
+        )["ok"]
+
+        # migrating side: an existing filter serves, a missing one ASKs
+        name_a = _name_owned_by(a[0].cluster.owner, addrs[0], prefix="ona")
+        ca.create_filter(name_a, capacity=1000, error_rate=0.01)
+        slot_a = S.key_slot(name_a)
+        a[0].ClusterSetSlot(
+            {"slot": slot_a, "state": "migrating", "addr": addrs[1]}
+        )
+        assert ca.include_batch(name_a, [b"x"]) is not None  # still served
+        # a missing filter in the migrating slot answers ASK: a hash
+        # tag pins the probe name to exactly that slot
+        missing = f"{{{name_a}}}:gone"
+        assert S.key_slot(missing) == slot_a
+        try:
+            ca.include_batch(missing, [b"x"])
+            raise AssertionError("expected ASK")
+        except BloomServiceError as e:
+            assert e.code == "ASK" and e.details["addr"] == addrs[1]
+    finally:
+        _teardown(a, b)
+
+
+# -- routed client -----------------------------------------------------------
+
+
+def test_cluster_client_routing_and_moved_heal(tmp_path):
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        cc = ClusterClient(startup_nodes=addrs)
+        assert cc.epoch == 1
+
+        names = [
+            _name_owned_by(a[0].cluster.owner, addrs[0], prefix="ra"),
+            _name_owned_by(a[0].cluster.owner, addrs[1], prefix="rb"),
+        ]
+        for n in names:
+            cc.create_filter(n, capacity=2000, error_rate=0.01)
+            cc.insert_batch(n, [b"k1", b"k2"])
+            assert cc.include_batch(n, [b"k1", b"k2", b"nope"]).tolist() == [
+                True, True, False,
+            ]
+        assert set(names) <= set(cc.list_filters())
+        assert cc.stats(names[0])["n_inserted"] >= 2
+
+        # flip names[0]'s slot to b OUT OF BAND (no migration — fresh
+        # create there) and prove the client heals via MOVED
+        slot = S.key_slot(names[0])
+        epoch = a[0].cluster.epoch() + 1
+        for svc in (a[0], b[0]):
+            svc.ClusterSetSlot(
+                {"slot": slot, "state": "node", "addr": addrs[1],
+                 "epoch": epoch}
+            )
+        before = obs_counters.get("client_moved_redirects")
+        cc.create_filter(names[0], capacity=2000, error_rate=0.01,
+                         exist_ok=True)
+        assert obs_counters.get("client_moved_redirects") > before
+        assert cc.epoch == epoch
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
+# -- live migration ----------------------------------------------------------
+
+
+def test_live_migration_under_load_exactly_once(tmp_path):
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        cc = ClusterClient(startup_nodes=addrs)
+        name = _name_owned_by(a[0].cluster.owner, addrs[0], prefix="cnt")
+        slot = S.key_slot(name)
+        cc.create_filter(name, capacity=50_000, error_rate=0.01,
+                         counting=True)
+        keys0 = [b"pre-%05d" % i for i in range(400)]
+        cc.insert_batch(name, keys0)
+
+        stop = threading.Event()
+        acked: list = []
+        failed: list = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                ks = [b"live-%04d-%02d" % (i, j) for j in range(20)]
+                try:
+                    cc.insert_batch(name, ks)
+                    acked.append(ks)
+                except Exception as e:  # noqa: BLE001
+                    failed.append(repr(e))
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.3)
+        resp = BloomClient(addrs[0]).migrate_slot(slot, addrs[1])
+        assert resp["ok"] and resp["filters_moved"] >= 1
+        assert resp["epoch"] == 2
+        time.sleep(0.2)
+        stop.set()
+        t.join()
+        # the dual-write + redirect healing should make the handoff
+        # invisible to the writer (transparent re-drives included)
+        assert not failed, f"writer saw errors across the handoff: {failed[:3]}"
+
+        # ownership flipped everywhere: source answers MOVED, maps agree
+        assert a[0].cluster.owner(slot) == addrs[1]
+        assert b[0].cluster.owner(slot) == addrs[1]
+        with pytest.raises(BloomServiceError, match="MOVED"):
+            BloomClient(addrs[0]).include_batch(name, [b"x"])
+        # the source retired its copy (logged drop)
+        assert name not in a[0]._filters
+
+        # zero lost: every acked key present at the new owner...
+        allkeys = keys0 + [k for ks in acked for k in ks]
+        assert cc.include_batch(name, allkeys).all(), (
+            "acked writes lost across the migration"
+        )
+        # ...and zero doubled: counting counts are exactly 1, so ONE
+        # delete round empties every key
+        cc.delete_batch(name, keys0)
+        for ks in acked:
+            cc.delete_batch(name, ks)
+        assert not cc.include_batch(name, allkeys).any(), (
+            "acked writes double-applied across the migration"
+        )
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
+def test_migration_resume_takes_tail_path(tmp_path):
+    """An interrupted migration re-driven: the target already holds the
+    filter, so the resume probes its gate and replays only the op-log
+    tail (no blob resend) — and the result is still exactly-once."""
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        cc = ClusterClient(startup_nodes=addrs)
+        # two hash-tagged counting filters on one source-owned slot —
+        # the injected fault lands BETWEEN their installs
+        tag = None
+        for i in range(4096):
+            if a[0].cluster.owner(S.key_slot(f"{{r{i}}}:a")) == addrs[0]:
+                tag = f"r{i}"
+                break
+        fa, fb = f"{{{tag}}}:a", f"{{{tag}}}:b"
+        slot = S.key_slot(fa)
+        for n in (fa, fb):
+            cc.create_filter(n, capacity=20_000, error_rate=0.01,
+                             counting=True)
+        keys0 = [b"r-%04d" % i for i in range(200)]
+        cc.insert_batch(fa, keys0)
+        cc.insert_batch(fb, keys0)
+
+        # passes (sorted filter order): fa probe(1), fa install(2),
+        # fb probe(3) ← fires — fa fully landed, fb untouched
+        faults.arm("cluster.migrate_send", "nth:3", times=1)
+        with pytest.raises(BloomServiceError):
+            BloomClient(addrs[0]).migrate_slot(slot, addrs[1])
+        faults.disarm("cluster.migrate_send")
+        # marks survive: source still owns + migrating, target importing
+        assert a[0].cluster.owner(slot) == addrs[0]
+        assert b[0].cluster.is_importing(slot)
+
+        # writes keep landing mid-window: fa's are dual-written live
+        # (its forward is armed and the target holds its gate). fb has
+        # no gate yet, so its writes park on IMPORT_NOT_READY re-drives
+        # until the resumed migration installs it — run them
+        # CONCURRENTLY with the re-drive to prove the park heals.
+        keys1 = [b"r2-%04d" % i for i in range(150)]
+        cc.insert_batch(fa, keys1)
+
+        before = obs_counters.get("cluster_migrate_snapshots_sent")
+        migrate_result: list = []
+        mt = threading.Thread(
+            target=lambda: migrate_result.append(
+                BloomClient(addrs[0], timeout=120).migrate_slot(
+                    slot, addrs[1]
+                )
+            )
+        )
+        mt.start()
+        cc.insert_batch(fb, keys1)  # parks until fb's snapshot lands
+        mt.join(timeout=120)
+        assert migrate_result, "re-driven migration did not finish"
+        resp = migrate_result[0]
+        assert resp["ok"] and resp["filters_moved"] == 2
+        # fa resumed via the op-log TAIL (no blob resend); only fb's
+        # blob shipped
+        assert resp["snapshots"] == 1
+        assert resp["tail_records"] >= 1
+        assert obs_counters.get("cluster_migrate_snapshots_sent") == before + 1
+
+        allkeys = keys0 + keys1
+        for n in (fa, fb):
+            assert cc.include_batch(n, allkeys).all(), f"lost writes ({n})"
+            cc.delete_batch(n, keys0)
+            cc.delete_batch(n, keys1)
+            assert not cc.include_batch(n, allkeys).any(), (
+                f"tail resume double-applied records ({n})"
+            )
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
+def test_migration_moves_all_hash_tagged_filters(tmp_path):
+    """Hash-tagged filters share a slot and migrate together — the
+    tenant-colocation story."""
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        cc = ClusterClient(startup_nodes=addrs)
+        tag = None
+        for i in range(4096):
+            if a[0].cluster.owner(S.key_slot(f"{{t{i}}}:x")) == addrs[0]:
+                tag = f"t{i}"
+                break
+        names = [f"{{{tag}}}:seen", f"{{{tag}}}:blocked", f"{{{tag}}}:spam"]
+        slot = S.key_slot(names[0])
+        assert all(S.key_slot(n) == slot for n in names)
+        for n in names:
+            cc.create_filter(n, capacity=2000, error_rate=0.01)
+            cc.insert_batch(n, [n.encode()])
+        resp = BloomClient(addrs[0]).migrate_slot(slot, addrs[1])
+        assert resp["filters_moved"] == 3
+        for n in names:
+            assert n in b[0]._filters and n not in a[0]._filters
+            assert cc.include(n, n.encode())
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
+# -- rebalancer --------------------------------------------------------------
+
+
+def test_even_ranges_and_plan_moves():
+    r = even_ranges(["a", "b", "c"])
+    assert r[0][0] == 0 and r[-1][1] == S.NUM_SLOTS - 1
+    total = sum(end - start + 1 for start, end, _ in r)
+    assert total == S.NUM_SLOTS
+
+    # plan: everything on "a", target a+b -> half the slots move to b
+    owners = {s: "a" for s in range(S.NUM_SLOTS)}
+    moves = plan_moves(owners, ["a", "b"])
+    assert len(moves) == S.NUM_SLOTS // 2
+    assert all(src == "a" and dst == "b" for _s, src, dst in moves)
+    # stray owners (nodes leaving the cluster) are fully drained
+    owners = {0: "dead", 1: "a", 2: "a"}
+    moves = plan_moves(owners, ["a", "b"])
+    assert ("dead" not in {dst for _s, _src, dst in moves})
+    balanced: dict = {"a": 2, "b": 0}
+    for slot, src, dst in moves:
+        balanced[dst] += 1
+    assert balanced["b"] >= 1
+
+
+def test_rebalance_cli_init_and_info(tmp_path, capsys):
+    from tpubloom.cluster import rebalance
+
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        nodes_arg = f"{a[2]},{b[2]}"
+        assert rebalance.main(["init", "--nodes", nodes_arg]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["unreachable"] == [] and out["epoch"] == 1
+        assert a[0].cluster.epoch() == 1 and b[0].cluster.epoch() == 1
+
+        assert rebalance.main(["info", "--nodes", nodes_arg]) == 0
+        views = json.loads(capsys.readouterr().out)
+        assert views[a[2]]["enabled"] and views[b[2]]["enabled"]
+
+        # rebalance of an already-even cluster plans zero moves
+        assert rebalance.main(["rebalance", "--nodes", nodes_arg,
+                               "--plan-only"]) == 0
+        plan = json.loads(capsys.readouterr().out.splitlines()[0])
+        assert plan["planned_moves"] == 0
+    finally:
+        _teardown(a, b)
+
+
+# -- the acceptance chaos story: SIGKILL the source mid-migration ------------
+
+_SERVER_CHILD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_migration_sigkill_acceptance(tmp_path):
+    """The ISSUE-9 acceptance scenario: a 3-primary cluster under
+    concurrent client load migrates a live slot holding counting
+    filters; the migration SOURCE (a real subprocess) is injected to
+    fail mid-migration and then SIGKILLed; the restarted source
+    re-drives the migration (resuming via the target's import gate +
+    its own replayed op log) to completion — and every acked write is
+    readable EXACTLY ONCE at the new owner."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    from tpubloom.obs.context import new_rid
+
+    port = _free_port()
+    src_addr = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    plog = tmp_path / "src-log"
+    script = tmp_path / "server_child.py"
+    script.write_text(_SERVER_CHILD)
+    child_args = [
+        _sys.executable, str(script), str(port),
+        "--cluster", "--repl-log-dir", str(plog),
+    ]
+    # pass 1 = filter 1's probe, 2 = its install, 3 = filter 2's probe,
+    # 4 = its install → the first MigrateSlot dies with one filter
+    # landed and one mid-flight
+    proc = subprocess.Popen(
+        child_args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**base_env,
+             "TPUBLOOM_FAULTS": "cluster.migrate_send=nth:4:times=1"},
+    )
+    t2 = _node(tmp_path, "t2")
+    t3 = _node(tmp_path, "t3")
+    boot = BloomClient(src_addr)
+    cc = None
+    try:
+        boot.wait_ready(timeout=120)
+        addrs = [src_addr, t2[2], t3[2]]
+        ranges = even_ranges(addrs)
+        boot.cluster_set_slot(assign=ranges, epoch=1)
+        for n in (t2, t3):
+            n[0].ClusterSetSlot({"assign": ranges, "epoch": 1})
+        owners = S.expand_ranges(ranges)
+
+        # two counting filters pinned to ONE source-owned slot (hash
+        # tag), so the nth:4 fault lands between their installs
+        tag = None
+        for i in range(4096):
+            if owners[S.key_slot(f"{{m{i}}}:a")] == src_addr:
+                tag = f"m{i}"
+                break
+        names = [f"{{{tag}}}:a", f"{{{tag}}}:b"]
+        slot = S.key_slot(names[0])
+        target_addr = t2[2]
+
+        cc = ClusterClient(
+            startup_nodes=addrs, max_retries=3,
+            backoff_base=0.05, backoff_max=0.5,
+        )
+        for n in names:
+            cc.create_filter(n, capacity=50_000, error_rate=0.01,
+                             counting=True)
+        seed = {n: [b"seed-%s-%03d" % (n.encode(), i) for i in range(200)]
+                for n in names}
+        for n in names:
+            cc.insert_batch(n, seed[n])
+
+        n_batches, batch_size = 16, 15
+        batches = [
+            (names[i % 2], [b"acc-%03d-%03d" % (i, j)
+                            for j in range(batch_size)])
+            for i in range(n_batches)
+        ]
+        acked: list = []
+        errors: list = []
+        done = threading.Event()
+
+        def writer():
+            # one rid per LOGICAL batch, reused across every retry —
+            # the dedup caches (rebuilt from log replay after the kill)
+            # and the import gates make re-drives exactly-once
+            for name, keys in batches:
+                rid = new_rid()
+                deadline = time.monotonic() + 240
+                while True:
+                    try:
+                        cc._keyed(
+                            "InsertBatch", {"name": name, "keys": keys},
+                            rid=rid,
+                        )
+                        acked.append((name, keys))
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.2)
+            done.set()
+
+        w = threading.Thread(target=writer, daemon=True)
+        w.start()
+        time.sleep(0.3)
+
+        # first migration attempt dies on the injected fault
+        try:
+            BloomClient(src_addr, timeout=120).migrate_slot(slot, target_addr)
+            raise AssertionError("expected the injected migration failure")
+        except (BloomServiceError, grpc.RpcError):
+            pass
+        # ... and then the whole source process dies
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        # restart (no injected faults): op-log replay restores the
+        # filters AND the rid-dedup cache; the slot map (with its
+        # migrating mark) reloads from the state dir
+        proc2 = subprocess.Popen(
+            child_args,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=base_env,
+        )
+        try:
+            BloomClient(src_addr).wait_ready(timeout=120)
+            resp = BloomClient(src_addr, timeout=120).migrate_slot(
+                slot, target_addr
+            )
+            assert resp["ok"] and resp["filters_moved"] == 2
+
+            assert done.wait(240), (
+                f"writer wedged; acked={len(acked)} last={errors[-3:]}"
+            )
+            w.join(timeout=10)
+            assert len(acked) == n_batches
+
+            # the handoff is visible: target owns, source answers MOVED
+            assert t2[0].cluster.owner(slot) == target_addr
+            with pytest.raises(BloomServiceError, match="MOVED"):
+                BloomClient(src_addr).include_batch(names[0], [b"x"])
+
+            # zero lost: every seed + acked key present at the new owner
+            per_name: dict = {n: list(seed[n]) for n in names}
+            for name, keys in acked:
+                per_name[name].extend(keys)
+            for n in names:
+                assert cc.include_batch(n, per_name[n]).all(), (
+                    f"acked writes lost across the killed migration ({n})"
+                )
+            # zero doubled: ONE delete round empties every counting key
+            for n in names:
+                cc.delete_batch(n, seed[n])
+            for name, keys in acked:
+                cc.delete_batch(name, keys)
+            for n in names:
+                assert not cc.include_batch(n, per_name[n]).any(), (
+                    f"acked writes double-applied ({n})"
+                )
+        finally:
+            proc2.send_signal(signal.SIGTERM)
+            try:
+                proc2.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        boot.close()
+        if cc is not None:
+            cc.close()
+        _teardown(t2, t3)
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_topology_events_push_repoints_client(tmp_path):
+    """ISSUE 9 satellite: a client subscribed to the sentinels'
+    TopologyEvents stream re-points on a topology change WITHOUT an
+    error-triggered refresh."""
+    from tpubloom.ha.sentinel import Sentinel
+
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    sent = Sentinel(a[2], peers=[], poll_s=0.1, down_after_s=30.0).start()
+    client = None
+    try:
+        client = BloomClient(sentinels=[sent.address], breaker_threshold=0)
+        assert client.address == a[2]
+        assert client.enable_topology_push()
+        before = obs_counters.get("client_topology_pushes")
+        # a failover completed elsewhere: the leader announces it
+        resp = sent.handle_AnnounceTopology(
+            {"epoch": 2, "primary": b[2], "replicas": [a[2]]}
+        )
+        assert resp["adopted"]
+        _wait(
+            lambda: client.address == b[2],
+            timeout=15,
+            msg="push-driven client re-point",
+        )
+        assert client.epoch == 2
+        assert obs_counters.get("client_topology_pushes") > before
+    finally:
+        if client is not None:
+            client.close()
+        sent.stop()
+        _teardown(a, b)
+
+
+def test_obs_aggregate_merges_with_node_labels(tmp_path):
+    """ISSUE 9 satellite (open since PR 1): one merged scrape across
+    nodes, per-node labels, headers deduped, dead nodes visible."""
+    from tpubloom.obs import aggregate as agg
+    from tpubloom.obs.exposition import parse_families
+    from tpubloom.obs.httpd import start_metrics_server
+
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    servers = []
+    try:
+        a[0].CreateFilter({"name": "agg-a", "capacity": 1000,
+                           "error_rate": 0.01})
+        b[0].CreateFilter({"name": "agg-b", "capacity": 1000,
+                           "error_rate": 0.01})
+        ms_a = start_metrics_server(a[0], port=0, host="127.0.0.1")
+        ms_b = start_metrics_server(b[0], port=0, host="127.0.0.1")
+        servers = [ms_a, ms_b]
+        dead = f"127.0.0.1:{_free_port()}"
+        nodes = [f"127.0.0.1:{ms_a.port}", f"127.0.0.1:{ms_b.port}", dead]
+        merged = agg.aggregate(nodes, timeout=3.0)
+
+        fams = parse_families(merged)
+        up = fams["tpubloom_aggregate_node_up"]
+        assert up[(("node", nodes[0]),)] == 1.0
+        assert up[(("node", nodes[1]),)] == 1.0
+        assert up[(("node", dead),)] == 0.0
+        created = fams["tpubloom_filters_created_total"]
+        assert created[(("node", nodes[0]),)] >= 1.0
+        assert created[(("node", nodes[1]),)] >= 1.0
+        # every sample line carries a node label; headers appear once
+        assert merged.count("# TYPE tpubloom_uptime_seconds gauge") == 1
+        per_filter = fams["tpubloom_filter_fill_ratio"]
+        labels = {dict(k).get("filter") for k in per_filter}
+        assert {"agg-a", "agg-b"} <= labels
+    finally:
+        for ms in servers:
+            ms.close()
+        _teardown(a, b)
+
+
+def test_latency_exemplars_link_buckets_to_slowlog_rids(tmp_path):
+    """ISSUE 9 satellite (ROADMAP item 6): latency buckets carry the
+    newest request's rid as an OpenMetrics exemplar — the same rid the
+    slowlog entry keeps, so a bucket spike walks straight to its
+    request. Stock scrapes stay annotation-free."""
+    import re
+    import urllib.request
+
+    from tpubloom.obs.exposition import render_service
+    from tpubloom.obs.httpd import start_metrics_server
+
+    service = BloomService()
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    ms = None
+    try:
+        client = BloomClient(f"127.0.0.1:{port}")
+        client.create_filter("ex", capacity=1000, error_rate=0.01)
+        client.insert_batch("ex", [b"k1", b"k2"])
+        client.include_batch("ex", [b"k1"])
+
+        plain = render_service(service)
+        assert '# {rid="' not in plain
+        annotated = render_service(service, exemplars=True)
+        rids = set(re.findall(r'# \{rid="([^"]+)"\}', annotated))
+        assert rids, "no exemplars rendered"
+        slowlog_rids = {e["rid"] for e in service.slowlog.entries()}
+        assert rids <= slowlog_rids, (
+            "exemplar rids must be findable in the slowlog"
+        )
+
+        # the HTTP surface: ?exemplars=1 opts in, default stays 0.0.4
+        ms = start_metrics_server(service, port=0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{ms.port}/metrics"
+        with urllib.request.urlopen(base, timeout=5) as r:
+            assert b'# {rid="' not in r.read()
+        with urllib.request.urlopen(base + "?exemplars=1", timeout=5) as r:
+            assert b'# {rid="' in r.read()
+        client.close()
+    finally:
+        if ms is not None:
+            ms.close()
+        srv.stop(grace=None)
+
+
+def test_cluster_smoke():
+    """benchmarks/cluster_smoke.py runs in tier-1 so the horizontal-
+    scaling surface cannot silently rot (and CI runs it standalone):
+    3 subprocess cluster nodes must beat the single-primary baseline."""
+    import importlib
+    import sys
+
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+    sys.path.insert(0, os.path.abspath(bench_dir))
+    try:
+        cluster_smoke = importlib.import_module("cluster_smoke")
+        result = cluster_smoke.run_smoke(duration_s=1.5)
+    finally:
+        sys.path.pop(0)
+    assert result["cluster_keys_per_sec"] > result["baseline_keys_per_sec"]
+    assert result["nodes"] == 3

@@ -66,6 +66,20 @@ GOLDEN = {
     # durability probe (ISSUE 5): numreplicas=0 answers immediately with
     # the achieved count — safe to replay raw on any primary
     "Wait": ("Wait", "82ab6e756d7265706c6963617300aa74696d656f75745f6d7332"),
+    # cluster verbs (ISSUE 9): on a NON-cluster server ClusterSlots
+    # answers enabled:false and the admin/migration verbs answer the
+    # structured CLUSTER_DISABLED error — both safe to replay raw, and
+    # both shapes the cluster clients parse
+    "ClusterSlots": ("ClusterSlots", "80"),
+    "ClusterSetSlot": (
+        "ClusterSetSlot", "82a4736c6f7407a57374617465a6737461626c65"
+    ),
+    "MigrateSlot": (
+        "MigrateSlot", "82a4736c6f7407a6746172676574ab3132372e302e302e313a31"
+    ),
+    "MigrateInstall": (
+        "MigrateInstall", "82a46e616d65a6676f6c64656ea570726f6265c3"
+    ),
 }
 
 #: one ``ReplAck`` client-streaming frame (ISSUE 5) — the exact bytes a
@@ -113,6 +127,10 @@ GOLDEN_DICTS = {
     "Promote": {},
     "ReplicaOf": {"primary": "NO ONE"},
     "Wait": {"numreplicas": 0, "timeout_ms": 50},
+    "ClusterSlots": {},
+    "ClusterSetSlot": {"slot": 7, "state": "stable"},
+    "MigrateSlot": {"slot": 7, "target": "127.0.0.1:1"},
+    "MigrateInstall": {"name": "golden", "probe": True},
 }
 
 
@@ -231,6 +249,16 @@ def test_golden_replay_against_live_server(raw_server):
     # no replicas) without blocking; the Ruby driver reads ok/nreplicas
     r = _call(ch, *GOLDEN["Wait"])
     assert r["ok"] and r["nreplicas"] == 0 and isinstance(r["seq"], int)
+
+    # cluster verbs (ISSUE 9) on a NON-cluster server: ClusterSlots
+    # probes cleanly (enabled false), admin/migration verbs answer the
+    # structured CLUSTER_DISABLED error the cluster clients parse
+    r = _call(ch, *GOLDEN["ClusterSlots"])
+    assert r["ok"] and r["enabled"] is False and r["ranges"] == []
+    for fixture in ("ClusterSetSlot", "MigrateSlot", "MigrateInstall"):
+        r = _call(ch, *GOLDEN[fixture])
+        assert r["ok"] is False, fixture
+        assert r["error"]["code"] == "CLUSTER_DISABLED", fixture
 
     r = _call(ch, *GOLDEN["SlowlogGet"])
     assert r["ok"] and len(r["entries"]) > 0

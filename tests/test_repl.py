@@ -117,7 +117,11 @@ def test_oplog_torn_tail_truncated_on_recovery(tmp_path):
     lg = OpLog(d)
     for i in range(5):
         lg.append("Clear", {"name": "f"})
-    seg = os.path.join(d, os.listdir(d)[0])
+    # pick the SEGMENT file — listdir order is filesystem-dependent and
+    # the dir also holds oplog.id (truncating that leaves the log whole)
+    seg = os.path.join(
+        d, next(f for f in sorted(os.listdir(d)) if f.endswith(".seg"))
+    )
     lg.close()
     size = os.path.getsize(seg)
     with open(seg, "r+b") as f:

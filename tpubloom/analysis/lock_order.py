@@ -1,0 +1,229 @@
+"""Declared lock-ORDER manifest vs. the runtime acquisition graph.
+
+PR 6 made lock ordering *observable*: the armed tracker
+(:mod:`tpubloom.utils.locks`) records every ``a → b`` acquisition edge
+and flags cycles. But a cycle only appears once BOTH orders exist — a
+brand-new edge that will deadlock against next month's code lands
+silently. This module closes that gap (ISSUE 9 satellite, ROADMAP item
+7): the project's intended lock ordering is DECLARED here, and any
+runtime edge outside the manifest is a finding — new nesting is a
+reviewed design decision, not an accident discovered at 3am.
+
+The manifest is a set of ``(outer, inner)`` lock-CLASS pairs (the names
+given to :func:`tpubloom.utils.locks.named_lock` and friends), seeded
+from the edges the chaos suites actually drive — including the new
+``cluster.*`` ranks the slot-migration paths mint (``cluster.state`` is
+a leaf: nothing may be acquired under it except the tracker's own
+bookkeeping, because migration forwards do network IO).
+
+Checking:
+
+* :func:`diff_edges` / :func:`check_report` — library API
+  (``tests/test_cluster.py`` runs it over the armed chaos module's
+  tracker + subprocess reports at teardown);
+* ``python -m tpubloom.analysis.lock_order [report.json|dir ...]`` —
+  operator CLI over ``lockcheck-*.json`` exit reports
+  (``$TPUBLOOM_LOCK_CHECK_DIR``); exit 1 on undeclared edges. ``--list``
+  prints the manifest.
+
+Growing the manifest is the point, not a failure: when a new edge is
+legitimate, add it here IN THE SAME PR with the code that mints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Iterable, Optional
+
+#: The declared acquisition order: (outer, inner) = "inner may be
+#: acquired while outer is held". Everything else is a finding.
+ALLOWED_EDGES = frozenset(
+    {
+        # -- op-log commit points (PR 3): the log append happens under
+        #    the lock its op committed under
+        ("filter.op", "repl.oplog"),
+        ("service.registry", "repl.oplog"),
+        # the checkpoint-keyed truncation sweep (every 64 appends) runs
+        # from _log_op — i.e. under the committing filter's op lock —
+        # and snapshots the registry. The REVERSE order must never be
+        # declared: registry holders always release before taking an op
+        # lock (create/drop/gauge walks), which is what keeps this a DAG
+        ("filter.op", "service.registry"),
+        # create/drop maintain the manifest + checkpoint trigger state
+        # under their commit locks
+        ("filter.op", "ckpt.trigger"),
+        ("service.registry", "ckpt.trigger"),
+        ("repl.oplog", "ckpt.trigger"),
+        # filter construction may trigger the native kernel build cache
+        ("filter.op", "native.build"),
+        ("service.registry", "native.build"),
+        # gauge snapshots read per-filter state under the op lock
+        ("filter.op", "obs.metrics"),
+        ("service.registry", "obs.metrics"),
+        ("filter.op", "obs.counters"),
+        ("service.registry", "obs.counters"),
+        ("repl.oplog", "obs.counters"),
+        ("ckpt.trigger", "obs.counters"),
+        ("ckpt.redis_sink", "obs.counters"),
+        ("service.admit", "obs.counters"),
+        ("service.dedup", "obs.counters"),
+        ("obs.metrics", "obs.counters"),
+        ("obs.slowlog", "obs.counters"),
+        ("faults.registry", "obs.counters"),
+        ("client.breaker", "obs.counters"),
+        ("client.topology", "obs.counters"),
+        ("repl.sessions", "obs.counters"),
+        ("repl.monitor_hub", "obs.counters"),
+        ("repl.ack_sender", "obs.counters"),
+        ("repl.applier_call", "obs.counters"),
+        ("sentinel.state", "obs.counters"),
+        ("sentinel.topo_events", "obs.counters"),
+        ("cluster.state", "obs.counters"),
+        ("cluster.client", "obs.counters"),
+        # fault points fire inside commit sections
+        ("filter.op", "faults.registry"),
+        ("service.registry", "faults.registry"),
+        ("repl.oplog", "faults.registry"),
+        ("repl.applier_call", "faults.registry"),
+        ("repl.ack_sender", "faults.registry"),
+        # replication: the applier serializes its call/ack plumbing, and
+        # record apply walks the normal commit locks
+        ("repl.applier_call", "repl.ack_sender"),
+        ("repl.applier_call", "repl.oplog"),
+        ("repl.applier_call", "filter.op"),
+        ("repl.applier_call", "service.registry"),
+        ("repl.applier_call", "ckpt.trigger"),
+        ("repl.applier_call", "obs.counters"),
+        # promotion / demotion re-plumb the service under the promote
+        # lock (PR 4)
+        ("service.promote", "service.registry"),
+        ("service.promote", "filter.op"),
+        ("service.promote", "repl.oplog"),
+        ("service.promote", "repl.sessions"),
+        ("service.promote", "repl.applier_call"),
+        ("service.promote", "repl.ack_sender"),
+        ("service.promote", "ckpt.trigger"),
+        ("service.promote", "obs.counters"),
+        ("service.promote", "faults.registry"),
+        # primary-side streaming reads sessions + log state
+        ("repl.sessions", "repl.oplog"),
+        ("repl.oplog", "obs.metrics"),
+        # -- cluster mode (ISSUE 9): the migration driver snapshots
+        #    under the filter lock and arms the dual-write there;
+        #    cluster.state itself is a LEAF apart from gauge updates —
+        #    node→node RPCs always run outside it
+        ("filter.op", "cluster.state"),
+        ("service.registry", "cluster.state"),
+        ("cluster.client", "client.breaker"),
+    }
+)
+
+
+def diff_edges(edges: Iterable[tuple]) -> list:
+    """Runtime edges not covered by the manifest, as finding dicts."""
+    findings = []
+    for edge in sorted(set(map(tuple, edges))):
+        if tuple(edge) not in ALLOWED_EDGES:
+            findings.append(
+                {
+                    "kind": "undeclared-lock-edge",
+                    "edge": list(edge),
+                    "message": (
+                        f"runtime acquisition {edge[0]!r} -> {edge[1]!r} "
+                        f"is not in the declared lock-order manifest "
+                        f"(tpubloom/analysis/lock_order.py) — declare it "
+                        f"deliberately or fix the nesting"
+                    ),
+                }
+            )
+    return findings
+
+
+def edges_of_report(report: dict) -> list:
+    """``[(from, to), ...]`` out of one lockcheck report dict (the
+    :func:`tpubloom.utils.locks.report` shape / exit-report JSON)."""
+    return [(e["from"], e["to"]) for e in report.get("edges", ())]
+
+
+def check_report(report: dict) -> list:
+    return diff_edges(edges_of_report(report))
+
+
+def check_live() -> list:
+    """Diff the in-process tracker's graph (armed test sessions)."""
+    from tpubloom.utils import locks
+
+    return check_report(locks.report())
+
+
+def _iter_report_paths(paths: Iterable[str]) -> list:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "lockcheck-*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpubloom.analysis.lock_order",
+        description="diff runtime lock-acquisition graphs against the "
+        "declared lock-order manifest",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="lockcheck-*.json reports (or directories of them); default: "
+        "$TPUBLOOM_LOCK_CHECK_DIR",
+    )
+    parser.add_argument(
+        "--list", action="store_true", dest="list_manifest",
+        help="print the declared manifest and exit",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    if args.list_manifest:
+        for outer, inner in sorted(ALLOWED_EDGES):
+            print(f"{outer} -> {inner}")
+        return 0
+    paths = args.paths or [os.environ.get("TPUBLOOM_LOCK_CHECK_DIR", "")]
+    paths = [p for p in paths if p]
+    if not paths:
+        parser.error("no report paths given and TPUBLOOM_LOCK_CHECK_DIR unset")
+    findings: list = []
+    n_reports = 0
+    for path in _iter_report_paths(paths):
+        try:
+            with open(path) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(
+                {
+                    "kind": "unreadable-report",
+                    "message": f"{path}: {e}",
+                }
+            )
+            continue
+        n_reports += 1
+        for finding in check_report(report):
+            findings.append({**finding, "report": path})
+    if args.as_json:
+        print(json.dumps(findings, indent=2))
+    else:
+        for f in findings:
+            print(f"[{f['kind']}] {f['message']}"
+                  + (f"  ({f['report']})" if "report" in f else ""))
+        print(
+            f"tpubloom.analysis.lock_order: {len(findings)} finding(s) "
+            f"across {n_reports} report(s)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Cluster mode (ISSUE 9) — hash-slot sharding, MOVED/ASK redirects,
+live slot migration. Redis Cluster parity for tpubloom:
+
+* :mod:`tpubloom.cluster.slots` — CRC16-mod-16384 slot hashing (hash
+  tags included), the persisted CRC-checked :class:`SlotMap` with
+  config epochs;
+* :mod:`tpubloom.cluster.node` — per-node :class:`ClusterState`: the
+  ownership check behind every keyed RPC (``MOVED``/``ASK``/
+  ``CLUSTERDOWN``), migration bookkeeping (dual-write forwards +
+  exactly-once import gates), node→node RPC links;
+* :mod:`tpubloom.cluster.migrate` — live slot migration
+  (``MigrateSlot``): snapshot blobs + op-log tail node→node, the
+  PR-3/5 resync machinery reused, with a dual-write window so no acked
+  write is lost and counting filters never double-apply;
+* :mod:`tpubloom.cluster.client` — the cluster-aware Python client:
+  slot→shard cache refreshed on ``MOVED``, one-shot ``ASK`` follow-ups,
+  per-shard sentinel/topology awareness layered on the PR-4 client;
+* :mod:`tpubloom.cluster.rebalance` — ``python -m tpubloom.cluster``:
+  ``init`` (seed assignments), ``info``, ``migrate``, ``rebalance``
+  (plan + drive slot moves toward an even spread).
+
+Server wiring: ``python -m tpubloom.server --cluster`` attaches a
+:class:`ClusterState`; see ``tpubloom/server/service.py``.
+"""
+
+from tpubloom.cluster.client import ClusterClient
+from tpubloom.cluster.node import ClusterState, KEYED_METHODS
+from tpubloom.cluster.slots import NUM_SLOTS, SlotMap, SlotStore, crc16, key_slot
+
+__all__ = [
+    "ClusterClient",
+    "ClusterState",
+    "KEYED_METHODS",
+    "NUM_SLOTS",
+    "SlotMap",
+    "SlotStore",
+    "crc16",
+    "key_slot",
+]

@@ -1,0 +1,7 @@
+"""``python -m tpubloom.cluster`` — cluster admin CLI (see rebalance.py)."""
+
+import sys
+
+from tpubloom.cluster.rebalance import main
+
+sys.exit(main())

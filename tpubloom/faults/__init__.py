@@ -30,6 +30,10 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
   kills the ack stream (the replica re-opens it on its next heartbeat)
 * ``ha.promote``        — at the top of replica→primary promotion
 * ``ha.vote``           — in the sentinel vote-request/grant path
+* ``cluster.migrate_send`` — slot migration, source side: before each
+  probe/snapshot-install/tail-record send to the new owner (ISSUE 9)
+* ``cluster.migrate_apply`` — slot migration, target side: in
+  ``MigrateInstall`` and per gated dual-write forward received
 * ``shard.insert`` / ``shard.query`` / ``shard.delete`` — per-shard
   points in :class:`tpubloom.parallel.sharded.ShardedBloomFilter`:
   fired once per shard the batch routes to, with ``shard=<index>``
@@ -97,6 +101,8 @@ KNOWN_POINTS = {
     "repl.ack_recv",
     "ha.promote",
     "ha.vote",
+    "cluster.migrate_send",
+    "cluster.migrate_apply",
     "shard.insert",
     "shard.query",
     "shard.delete",

@@ -203,18 +203,33 @@ class Sentinel:
         self.failovers = 0
         self._stop = threading.Event()
         self._channels: dict = {}
+        #: topology-push machinery (ISSUE 9 satellite): subscribers of
+        #: the ``TopologyEvents`` stream wait on this version counter —
+        #: every committed topology change bumps it (OUTSIDE the state
+        #: lock, so the two locks never nest in both orders)
+        self._topo_version = 0
+        self._topo_cond = locks.named_condition("sentinel.topo_events")
+        self._topo_subscribers = 0
         self._thread = threading.Thread(
             target=self._run, name="tpubloom-sentinel", daemon=True
         )
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="sentinel-rpc"
+                # subscribers park a worker each for their stream
+                # lifetime — size past the unary handlers' needs
+                max_workers=16, thread_name_prefix="sentinel-rpc"
             )
         )
         handlers = {
             m: grpc.unary_unary_rpc_method_handler(self._wrap(m))
             for m in protocol.SENTINEL_METHODS
         }
+        handlers.update(
+            {
+                m: grpc.unary_stream_rpc_method_handler(self._wrap_stream(m))
+                for m in protocol.SENTINEL_STREAM_METHODS
+            }
+        )
         self._server.add_generic_rpc_handlers(
             (
                 grpc.method_handlers_generic_handler(
@@ -280,6 +295,77 @@ class Sentinel:
             return protocol.encode(resp)
 
         return unary_unary
+
+    def _wrap_stream(self, method: str):
+        gen_fn = getattr(self, "stream_" + method)
+
+        def unary_stream(request: bytes, context):
+            try:
+                req = protocol.decode(request) if request else {}
+            except Exception:  # noqa: BLE001 — a bad frame is an empty req
+                req = {}
+            for msg in gen_fn(req, context):
+                yield protocol.encode(msg)
+
+        return unary_stream
+
+    def _notify_topology(self) -> None:
+        """Wake TopologyEvents subscribers. MUST be called with the
+        state lock RELEASED: the stream generator takes the condition
+        then the state lock, so taking them here in the opposite order
+        would be a lock-order cycle (the runtime tracker enforces
+        this)."""
+        with self._topo_cond:
+            self._topo_version += 1
+            self._topo_cond.notify_all()
+        _counters.incr("sentinel_topology_pushes")
+
+    #: cap on concurrent TopologyEvents subscribers: each one parks a
+    #: gRPC worker for its stream lifetime, and the pool is shared with
+    #: VoteDown/Topology — unbounded subscribers would starve the very
+    #: election RPCs the push exists to announce. Rejected subscribers
+    #: get an error frame and fall back to refresh-on-error.
+    MAX_TOPO_SUBSCRIBERS = 8
+
+    def stream_TopologyEvents(
+        self, req: dict, context, *, heartbeat_s: float = 1.0
+    ):
+        """Server-stream behind ``TopologyEvents`` (ISSUE 9 satellite):
+        the current view immediately, a fresh ``topology`` frame on
+        every change, heartbeats while idle — subscribed clients
+        re-point on failover without a refresh-on-error round trip."""
+        with self._topo_cond:
+            if self._topo_subscribers >= self.MAX_TOPO_SUBSCRIBERS:
+                full = True
+            else:
+                full = False
+                self._topo_subscribers += 1
+        if full:
+            yield {
+                "kind": "error",
+                "ok": False,
+                "code": "SUBSCRIBERS_FULL",
+                "message": "TopologyEvents subscriber cap reached on this "
+                "sentinel; subscribe elsewhere or poll Topology",
+            }
+            return
+        try:
+            last = -1
+            while context.is_active() and not self._stop.is_set():
+                with self._topo_cond:
+                    if self._topo_version == last:
+                        self._topo_cond.wait(heartbeat_s)
+                    version = self._topo_version
+                if version != last:
+                    last = version
+                    with self._lock:
+                        view = self.topology.to_dict()
+                    yield {"kind": "topology", "ok": True, **view}
+                else:
+                    yield {"kind": "heartbeat", "epoch": self.topology.epoch}
+        finally:
+            with self._topo_cond:
+                self._topo_subscribers -= 1
 
     def _channel(self, address: str):
         ch = self._channels.get(address)
@@ -377,6 +463,8 @@ class Sentinel:
                     "adopted topology epoch %d (primary %s) from peer",
                     incoming.epoch, incoming.primary,
                 )
+        if adopted:
+            self._notify_topology()
         return {"ok": True, "adopted": adopted, "epoch": self.topology.epoch}
 
     # -- the monitor loop ----------------------------------------------------
@@ -441,11 +529,13 @@ class Sentinel:
             log.info("sentinel %s: %s is back", self.sentinel_id, primary)
         self._sdown = False
         _counters.set_gauge("sentinel_sdown", 0.0)
+        changed = False
         with self._lock:
             node_epoch = int(h.get("epoch") or 0)
             if node_epoch > self.topology.epoch:
                 self.topology.epoch = node_epoch
                 self._persist_state()
+                changed = True
             if h.get("role") == "replica":
                 # the watched node was demoted behind our back (manual
                 # REPLICAOF / a failover we missed): follow its view
@@ -460,20 +550,22 @@ class Sentinel:
                         self.topology.replicas.append(primary)
                     self.topology.primary = upstream
                     self._persist_state()
-                return
-            # discover announced replicas (INFO replication parity)
-            sessions = (h.get("replication") or {}).get("replicas") or ()
-            listens = [s.get("listen") for s in sessions if s.get("listen")]
-            discovered = False
-            for addr in listens:
-                if addr not in self.topology.replicas:
-                    self.topology.replicas.append(addr)
-                    discovered = True
-            if discovered:
-                self._persist_state()
-            _counters.set_gauge(
-                "sentinel_known_replicas", len(self.topology.replicas)
-            )
+                    changed = True
+            else:
+                # discover announced replicas (INFO replication parity)
+                sessions = (h.get("replication") or {}).get("replicas") or ()
+                listens = [s.get("listen") for s in sessions if s.get("listen")]
+                for addr in listens:
+                    if addr not in self.topology.replicas:
+                        self.topology.replicas.append(addr)
+                        changed = True
+                if changed:
+                    self._persist_state()
+                _counters.set_gauge(
+                    "sentinel_known_replicas", len(self.topology.replicas)
+                )
+        if changed:
+            self._notify_topology()
 
     def _fence_stale_primaries(self) -> None:
         """Demote any watched-for node that reappears claiming a stale
@@ -516,6 +608,7 @@ class Sentinel:
                 ):
                     self.topology.replicas.append(addr)
                 self._persist_state()
+            self._notify_topology()
 
     # -- failover ------------------------------------------------------------
 
@@ -548,6 +641,7 @@ class Sentinel:
                         self._first_fail = None
                         self._fence_watch.add(old_primary)
                         self._persist_state()
+                self._notify_topology()
                 log.info(
                     "adopted completed failover: %s is primary at epoch %d",
                     addr, incoming.epoch,
@@ -679,6 +773,7 @@ class Sentinel:
                 self._first_fail = None
                 self._fence_watch.add(old_primary)
                 self._persist_state()
+            self._notify_topology()
             self.failovers += 1
             _counters.incr("sentinel_failovers")
             log.warning(

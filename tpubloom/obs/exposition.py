@@ -142,13 +142,21 @@ def _render_histogram(
     series: Iterable[tuple[dict, dict]],
     bucket_bounds_us: list,
     help_text: str,
+    *,
+    exemplars: bool = False,
 ) -> None:
-    """``series`` = iterable of (labels, {counts, total_us, n})."""
+    """``series`` = iterable of (labels, {counts, total_us, n,
+    exemplars?}). With ``exemplars=True`` each bucket line carries its
+    OpenMetrics exemplar (``# {rid="..."} value ts``) when one was
+    recorded — the rid links the bucket to the matching slowlog entry /
+    trace span (ISSUE 9 satellite; request the view with
+    ``/metrics?exemplars=1``, stock 0.0.4 scrapes stay untouched)."""
     wrote_header = False
     for labels, hist in series:
         if not wrote_header:
             _header(out, name, "histogram", help_text)
             wrote_header = True
+        bucket_exemplars = hist.get("exemplars") or {}
         cum = 0
         for i, count in enumerate(hist["counts"]):
             cum += count
@@ -157,18 +165,28 @@ def _render_histogram(
                 if i < len(bucket_bounds_us)
                 else "+Inf"
             )
-            out.append(
-                _line(f"{name}_bucket", cum, {**labels, "le": le})
-            )
+            line = _line(f"{name}_bucket", cum, {**labels, "le": le})
+            ex = bucket_exemplars.get(i) if exemplars else None
+            if ex is None and exemplars:
+                ex = bucket_exemplars.get(str(i))  # msgpack/json round trips
+            if ex is not None:
+                line += (
+                    f' # {{rid="{_escape(ex["rid"])}"}} '
+                    f'{_fmt(ex["value_s"])} {_fmt(ex["ts"])}'
+                )
+            out.append(line)
         out.append(_line(f"{name}_sum", hist["total_us"] / 1e6, labels))
         out.append(_line(f"{name}_count", hist["n"], labels))
 
 
-def render_service(service) -> str:
+def render_service(service, *, exemplars: bool = False) -> str:
     """Render a full scrape for a live ``BloomService``.
 
     Duck-typed on: ``service.metrics.export()``, ``service.slowlog``, and
     ``service.gauge_snapshot()`` (see ``server/service.py``).
+    ``exemplars=True`` annotates the RPC latency buckets with their
+    newest request id (OpenMetrics exemplar syntax) — the same rid the
+    slowlog keeps, so a latency spike walks straight to its request.
     """
     met = service.metrics.export()
     out: list[str] = []
@@ -200,6 +218,7 @@ def render_service(service) -> str:
         ),
         bounds,
         "End-to-end RPC latency by method",
+        exemplars=exemplars,
     )
     _render_histogram(
         out,

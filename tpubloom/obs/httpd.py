@@ -23,12 +23,35 @@ class MetricsServer:
     port 0 for an ephemeral one — tests and the smoke benchmark do)."""
 
     def __init__(self, render_fn, port: int = 0, host: str = "0.0.0.0"):
+        # probe ONCE whether render_fn takes the exemplars knob — a
+        # try/except TypeError at request time would also swallow real
+        # TypeErrors raised inside the render and silently serve the
+        # un-annotated view
+        import inspect
+
+        try:
+            has_exemplars_knob = "exemplars" in inspect.signature(
+                render_fn
+            ).parameters
+        except (TypeError, ValueError):  # builtins/partials w/o signature
+            has_exemplars_knob = False
+
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
+                    # ?exemplars=1 opts into the OpenMetrics-style
+                    # exemplar annotations (ISSUE 9 satellite); stock
+                    # 0.0.4 scrapers keep the unannotated default
+                    want_exemplars = (
+                        has_exemplars_knob
+                        and "exemplars=1" in query.split("&")
+                    )
                     try:
-                        body = render_fn().encode()
+                        if want_exemplars:
+                            body = render_fn(exemplars=True).encode()
+                        else:
+                            body = render_fn().encode()
                     except Exception:  # a broken gauge must not 500 forever silently
                         log.exception("metrics render failed")
                         self.send_error(500, "metrics render failed")
@@ -66,7 +89,12 @@ class MetricsServer:
 
 
 def start_metrics_server(service, port: int = 0, host: str = "0.0.0.0") -> MetricsServer:
-    """Serve ``render_service(service)`` at ``http://host:port/metrics``."""
+    """Serve ``render_service(service)`` at ``http://host:port/metrics``
+    (``?exemplars=1`` adds the rid exemplars on latency buckets)."""
     from tpubloom.obs.exposition import render_service
 
-    return MetricsServer(lambda: render_service(service), port=port, host=host)
+    return MetricsServer(
+        lambda exemplars=False: render_service(service, exemplars=exemplars),
+        port=port,
+        host=host,
+    )

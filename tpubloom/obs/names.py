@@ -33,9 +33,23 @@ COUNTERS = (
     "ckpt_corrupt_detected",
     "ckpt_quarantine_evicted",
     "ckpt_restore_read_errors",
+    "client_ask_redirects",
+    "client_moved_redirects",
     "client_primary_redirects",
     "client_replica_fallbacks",
+    "client_slot_refreshes",
+    "client_topology_pushes",
     "client_topology_refreshes",
+    "cluster_ask_redirects",
+    "cluster_filters_migrated",
+    "cluster_forward_dups",
+    "cluster_forward_failures",
+    "cluster_forwards",
+    "cluster_migrate_installs",
+    "cluster_migrate_snapshots_sent",
+    "cluster_migrate_tail_records",
+    "cluster_migrations_completed",
+    "cluster_moved_redirects",
     "delete_dedup_hits",
     "faults_injected",
     "filters_created",
@@ -84,6 +98,7 @@ COUNTERS = (
     "sentinel_fenced",
     "sentinel_odown_agreed",
     "sentinel_sdown_entered",
+    "sentinel_topology_pushes",
     "sentinel_votes_granted",
     "stale_epoch_rejected",
 )
@@ -91,6 +106,10 @@ COUNTERS = (
 #: Last-write-wins levels (rendered as Prometheus ``gauge``).
 GAUGES = (
     "client_breaker_state",
+    "cluster_config_epoch",
+    "cluster_slots_importing",
+    "cluster_slots_migrating",
+    "cluster_slots_owned",
     "ha_epoch",
     "ha_role",
     "monitor_subscribers",

@@ -98,10 +98,7 @@ _SHED_CODES = frozenset({"RESOURCE_EXHAUSTED", "DRAINING"})
 #: the host you asked), Health is a liveness probe of its target.
 _REPLICA_READS = frozenset({"QueryBatch"})
 
-_CHANNEL_OPTIONS = [
-    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-    ("grpc.max_send_message_length", 256 * 1024 * 1024),
-]
+_CHANNEL_OPTIONS = list(protocol.CHANNEL_OPTIONS)
 
 _BREAKER_GAUGE = {"closed": 0, "half-open": 1, "open": 2}
 
@@ -321,6 +318,21 @@ class BloomClient:
             ch = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
             self._replicas.append((addr, ch, self._make_calls(ch)))
         self._rr = 0
+        #: channels replaced by the topology-PUSH thread (ISSUE 9
+        #: satellite): retired instead of closed at swap time — an
+        #: in-flight call on the old channel must fail over through the
+        #: normal retry path, not die on an out-of-band close. Bounded:
+        #: only the newest few stay open (older ones have had ample
+        #: grace by the next topology change); the rest close in
+        #: :meth:`_retire_channel`, the remainder at :meth:`close`.
+        self._retired_channels: list = []
+        #: serializes topology adoption between the push thread and
+        #: user threads' refresh-on-error — an unlocked epoch compare
+        #: could interleave so an OLDER view is applied last
+        self._topo_lock = locks.named_lock("client.topology")
+        self._push_stop: Optional[threading.Event] = None
+        self._push_thread: Optional[threading.Thread] = None
+        self._push_call = None
 
     @staticmethod
     def _make_calls(channel) -> dict:
@@ -373,14 +385,18 @@ class BloomClient:
     def _try_replica(self, method: str, req: dict) -> Optional[dict]:
         """One replica attempt for a routed read; None = fall back to the
         primary path (replica down, still syncing, or otherwise unable)."""
+        # snapshot the pool: the topology-push thread REPLACES
+        # self._replicas wholesale, so indexing the attribute twice
+        # could race an adoption into IndexError/ZeroDivisionError
+        replicas = self._replicas
         if (
-            not self._replicas
+            not replicas
             or self.read_preference != "replica"
             or method not in _REPLICA_READS
         ):
             return None
-        self._rr = (self._rr + 1) % len(self._replicas)
-        addr, _, calls = self._replicas[self._rr]
+        self._rr = rr = (self._rr + 1) % len(replicas)
+        addr, _, calls = replicas[rr % len(replicas)]
         try:
             return self._call_once(method, req, calls)
         except (grpc.RpcError, protocol.BloomServiceError):
@@ -389,19 +405,31 @@ class BloomClient:
             obs_counters.incr("client_replica_fallbacks")
             return None
 
-    def _follow_primary(self, address: str) -> None:
+    def _follow_primary(self, address: str, *, close_old: bool = True) -> None:
         """READONLY redirect: re-point the primary channel (the old
-        channel is closed; replica channels are untouched)."""
+        channel is closed; replica channels are untouched).
+        ``close_old=False`` retires the old channel instead of closing
+        it — the topology-push thread swaps channels while calls may be
+        in flight on the old one."""
         old = self._channel
         self.address = address
         self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._calls = self._make_calls(self._channel)
         self._stream_calls = self._make_stream_calls(self._channel)
-        old.close()
+        if close_old:
+            old.close()
+        else:
+            self._retire_channel(old)
         obs_counters.incr("client_primary_redirects")
 
-    def _set_replicas(self, addrs: Sequence[str]) -> None:
-        """Replace the replica channel pool (topology refresh)."""
+    def _set_replicas(
+        self, addrs: Sequence[str], *, close_old: bool = True
+    ) -> None:
+        """Replace the replica channel pool (topology refresh).
+        ``close_old=False`` retires dropped channels instead of closing
+        them — the PUSH thread swaps the pool while replica reads may
+        be in flight, and an out-of-band close would kill them instead
+        of letting the replica-fallback path absorb the loss."""
         keep = {a: (a, ch, calls) for a, ch, calls in self._replicas}
         fresh = []
         for addr in addrs:
@@ -411,9 +439,38 @@ class BloomClient:
                 ch = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
                 fresh.append((addr, ch, self._make_calls(ch)))
         for _, ch, _ in keep.values():
-            ch.close()
+            if close_old:
+                ch.close()
+            else:
+                self._retire_channel(ch)
         self._replicas = fresh
         self._rr = 0
+
+    def _retire_channel(self, ch) -> None:
+        self._retired_channels.append(ch)
+        while len(self._retired_channels) > 8:
+            # anything older than the last few swaps has had ample
+            # grace for its in-flight calls — close it, or a long-lived
+            # push-enabled client leaks a channel per failover
+            self._retired_channels.pop(0).close()
+
+    def _adopt_topology(self, topo: dict, *, close_old: bool = True) -> bool:
+        """Adopt one sentinel view iff its epoch is not older than the
+        cached one; True iff the PRIMARY changed. Serialized: the push
+        thread and user-thread refreshes must not interleave their
+        epoch compare-and-apply, or an older view can be applied last."""
+        with self._topo_lock:
+            epoch = int(topo.get("epoch") or 0)
+            if self.epoch is not None and epoch < self.epoch:
+                return False
+            self.epoch = epoch
+            changed = (
+                bool(topo.get("primary")) and topo["primary"] != self.address
+            )
+            if changed:
+                self._follow_primary(topo["primary"], close_old=close_old)
+            self._set_replicas(topo.get("replicas") or (), close_old=close_old)
+            return changed
 
     def refresh_topology(self) -> bool:
         """Re-resolve the cluster view from the sentinel list; adopt it
@@ -425,26 +482,86 @@ class BloomClient:
         topo = fetch_topology(self.sentinels)
         if topo is None:
             return False
-        epoch = int(topo.get("epoch") or 0)
-        if self.epoch is not None and epoch < self.epoch:
-            return False
-        self.epoch = epoch
         obs_counters.incr("client_topology_refreshes")
-        changed = bool(topo.get("primary")) and topo["primary"] != self.address
-        if changed:
-            self._follow_primary(topo["primary"])
-        self._set_replicas(topo.get("replicas") or ())
-        return changed
+        # retire (never close) the swapped channels: with the push
+        # thread or any multi-threaded use, an out-of-band close would
+        # kill a sibling thread's in-flight call instead of letting it
+        # fail over through the retry path; the retire cap bounds them
+        return self._adopt_topology(topo, close_old=False)
 
-    def _rpc(self, method: str, req: dict) -> dict:
+    # -- sentinel topology push (ISSUE 9 satellite) --------------------------
+
+    def enable_topology_push(self) -> bool:
+        """Subscribe to the sentinels' ``TopologyEvents`` server-stream
+        on a background thread: failovers re-point this client the
+        moment the sentinel announces them, instead of waiting for the
+        next error-triggered refresh (refresh-on-error stays as the
+        fallback — a dead push stream degrades, it does not break).
+        Returns False (no thread) when the client has no sentinels."""
+        if not self.sentinels or self._push_thread is not None:
+            return False
+        self._push_stop = threading.Event()
+        self._push_thread = threading.Thread(
+            target=self._topology_push_loop,
+            name="tpubloom-topology-push",
+            daemon=True,
+        )
+        self._push_thread.start()
+        return True
+
+    def _topology_push_loop(self) -> None:
+        stop = self._push_stop
+        backoff = 0.2
+        # randomized order: every client of the fleet gets the same
+        # sentinel list, and each subscriber parks a worker on its
+        # sentinel for the stream lifetime — spreading subscriptions
+        # keeps any one sentinel's pool free for election RPCs (the
+        # sentinel additionally caps subscribers and answers
+        # SUBSCRIBERS_FULL, which lands here as an ended stream)
+        order = list(self.sentinels)
+        random.shuffle(order)
+        while not stop.is_set():
+            for addr in order:
+                if stop.is_set():
+                    return
+                channel = grpc.insecure_channel(addr)
+                try:
+                    call = channel.unary_stream(
+                        protocol.sentinel_method_path("TopologyEvents"),
+                        request_serializer=lambda b: b,
+                        response_deserializer=lambda b: b,
+                    )(protocol.encode({}), timeout=None)
+                    self._push_call = call
+                    for raw in call:
+                        if stop.is_set():
+                            return
+                        frame = protocol.decode(raw)
+                        if frame.get("kind") != "topology":
+                            continue  # heartbeat keeps the stream alive
+                        backoff = 0.2  # a live stream resets the backoff
+                        if self._adopt_topology(frame, close_old=False):
+                            obs_counters.incr("client_topology_pushes")
+                except grpc.RpcError:
+                    pass
+                except Exception:  # noqa: BLE001 — the push is best-effort
+                    pass
+                finally:
+                    self._push_call = None
+                    channel.close()
+            stop.wait(backoff * (0.5 + random.random()))
+            backoff = min(5.0, backoff * 2)
+
+    def _rpc(self, method: str, req: dict, *, rid: Optional[str] = None) -> dict:
         # request-correlation id: one per LOGICAL call (retries and the
         # NOT_FOUND heal's final retry share it); exposed as last_rid so
         # callers can find their request in the server slowlog/trace.
         # DeleteBatch and non-idempotent InsertBatch retries lean on this
         # id: the server's dedup cache answers a replayed rid from cache
-        # instead of re-applying.
+        # instead of re-applying. Callers spanning MULTIPLE _rpc calls
+        # per logical op (the cluster client's redirect healing) pass
+        # ``rid=`` so every hop shares one id.
         locks.note_blocking("client.rpc")
-        self.last_rid = rid = new_rid()
+        self.last_rid = rid = rid or new_rid()
         req = {**req, "rid": rid}
         if self.epoch is not None and method in protocol.MUTATING_METHODS:
             req["epoch"] = self.epoch
@@ -833,6 +950,31 @@ class BloomClient:
             req["epoch"] = epoch
         return self._rpc("ReplicaOf", req)
 
+    # -- cluster mode (ISSUE 9) ----------------------------------------------
+
+    def cluster_slots(self) -> dict:
+        """This node's slot-map view (Redis ``CLUSTER SLOTS`` parity):
+        ``{enabled, epoch, ranges, migrating, importing}``. Routed
+        cluster traffic wants :class:`tpubloom.cluster.ClusterClient`;
+        this is the per-node admin/bootstrap probe."""
+        return self._rpc("ClusterSlots", {})
+
+    def cluster_set_slot(self, **req) -> dict:
+        """Admin verb (``CLUSTER SETSLOT`` parity): ``slot=/state=/addr=``
+        or the bulk ``assign=[[start, end, addr], ...], epoch=`` form."""
+        return self._rpc("ClusterSetSlot", req)
+
+    def migrate_slot(self, slot: int, target: str) -> dict:
+        """Drive the live migration of ``slot`` from this node (its
+        owner) to ``target``; blocks until the handoff finalizes."""
+        return self._rpc("MigrateSlot", {"slot": int(slot), "target": target})
+
+    def migrate_install_probe(self, name: str) -> dict:
+        """Resume probe of the migration target's import gate for one
+        filter (``{"have": <source seq>|None}``) — the node→node
+        ``MigrateInstall`` hop's read-only form, exposed for tooling."""
+        return self._rpc("MigrateInstall", {"name": name, "probe": True})
+
     # -- observability -------------------------------------------------------
 
     def slowlog_get(self, n: Optional[int] = None) -> list:
@@ -866,7 +1008,18 @@ class BloomClient:
         )
 
     def close(self) -> None:
+        if self._push_stop is not None:
+            self._push_stop.set()
+            call = self._push_call
+            if call is not None:
+                call.cancel()
+            self._push_thread.join(timeout=5.0)
+            self._push_thread = None
+            self._push_stop = None
         self._channel.close()
+        for ch in self._retired_channels:
+            ch.close()
+        self._retired_channels = []
         for _, ch, _ in self._replicas:
             ch.close()
 

@@ -23,7 +23,14 @@ from tpubloom.utils import locks
 
 
 class LatencyHistogram:
-    """Fixed log2 buckets from 1us to ~67s — O(1) observe via bit_length."""
+    """Fixed log2 buckets from 1us to ~67s — O(1) observe via bit_length.
+
+    Exemplars (ISSUE 9 satellite): each bucket remembers the NEWEST
+    observation's request id — the OpenMetrics exemplar linking a
+    latency bucket to the exact request behind it, which is the same
+    rid the slowlog entry and the profiler span carry. One slot per
+    bucket (last-write-wins): an exemplar is a breadcrumb, not a log.
+    """
 
     BUCKETS = [2**i for i in range(27)]  # microsecond upper bounds
 
@@ -31,14 +38,23 @@ class LatencyHistogram:
         self.counts = [0] * (len(self.BUCKETS) + 1)
         self.total_us = 0
         self.n = 0
+        #: bucket index -> {"rid", "value_s", "ts"} (newest observation)
+        self.exemplars: dict = {}
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, *, rid: Optional[str] = None) -> None:
         us = seconds * 1e6
         self.total_us += us
         self.n += 1
         # us < 2^i  <=>  int(us).bit_length() <= i, so bit_length IS the
         # bucket index (clamped into the overflow bucket) — no linear scan
-        self.counts[min(int(us).bit_length(), len(self.BUCKETS))] += 1
+        bucket = min(int(us).bit_length(), len(self.BUCKETS))
+        self.counts[bucket] += 1
+        if rid:
+            self.exemplars[bucket] = {
+                "rid": rid,
+                "value_s": seconds,
+                "ts": time.time(),
+            }
 
     def cumulative(self) -> list:
         """Cumulative bucket counts (len(BUCKETS)+1, last = n) — the
@@ -50,7 +66,12 @@ class LatencyHistogram:
         return out
 
     def export(self) -> dict:
-        return {"counts": list(self.counts), "total_us": self.total_us, "n": self.n}
+        return {
+            "counts": list(self.counts),
+            "total_us": self.total_us,
+            "n": self.n,
+            "exemplars": {k: dict(v) for k, v in self.exemplars.items()},
+        }
 
     def summary(self) -> dict:
         if not self.n:
@@ -93,11 +114,17 @@ class Metrics:
             self.counters[name] += n
 
     def observe_rpc(
-        self, method: str, seconds: float, phases: Optional[dict] = None
+        self,
+        method: str,
+        seconds: float,
+        phases: Optional[dict] = None,
+        rid: Optional[str] = None,
     ) -> None:
-        """File one finished RPC: total latency + its phase breakdown."""
+        """File one finished RPC: total latency + its phase breakdown.
+        ``rid`` becomes the latency bucket's exemplar — the slowlog /
+        trace correlation handle (ISSUE 9 satellite)."""
         with self._lock:
-            self.latency[method].observe(seconds)
+            self.latency[method].observe(seconds, rid=rid)
             for phase_name, phase_s in (phases or {}).items():
                 self.phases[f"{method}/{phase_name}"].observe(phase_s)
 

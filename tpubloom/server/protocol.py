@@ -27,6 +27,15 @@ import msgpack
 
 SERVICE = "tpubloom.BloomService"
 
+#: gRPC message-size caps shared by every hop that may carry a filter
+#: snapshot blob (client channels, node→node migration links, the
+#: server itself) — ONE definition, or a future bump would miss a copy
+#: and surface as RESOURCE_EXHAUSTED only on the stale path.
+CHANNEL_OPTIONS = (
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+)
+
 METHODS = (
     "Health",
     "CreateFilter",
@@ -43,6 +52,10 @@ METHODS = (
     "Promote",
     "ReplicaOf",
     "Wait",
+    "ClusterSlots",
+    "ClusterSetSlot",
+    "MigrateSlot",
+    "MigrateInstall",
 )
 
 #: Server-streaming RPCs (ISSUE 3): each response frame is one msgpack
@@ -101,6 +114,26 @@ MUTATING_METHODS = frozenset(
 #: an overloaded cluster).
 HA_METHODS = frozenset({"Promote", "ReplicaOf"})
 
+#: Cluster-mode RPCs (ISSUE 9 — Redis Cluster parity). ``ClusterSlots``
+#: answers the node's slot map (``{enabled, epoch, self, ranges:
+#: [[start, end, addr], ...], migrating, importing}`` — CLUSTER SLOTS
+#: parity; clients build their slot→shard cache from it).
+#: ``ClusterSetSlot`` is the admin verb (CLUSTER SETSLOT parity, plus a
+#: bulk ``assign`` form the rebalancer pushes whole maps with).
+#: ``MigrateSlot`` ``{slot, target}`` drives a live slot migration from
+#: the owning node; ``MigrateInstall`` is its node→node snapshot hop
+#: (``{name, blob, src_seq}``; ``{name, probe: true}`` probes the
+#: target's resume point). A keyed request for a slot this node does
+#: not own answers ``MOVED`` (details ``{slot, addr}``); a migrating
+#: slot's missing filter answers ``ASK`` (one-shot redirect, the
+#: follow-up carries ``asking: true`` — ASKING parity); an unassigned
+#: slot answers ``CLUSTERDOWN``. Migration forwards additionally stamp
+#: ``src_seq`` (the record's source-log seq) for the target's
+#: exactly-once import gate.
+CLUSTER_METHODS = frozenset(
+    {"ClusterSlots", "ClusterSetSlot", "MigrateSlot", "MigrateInstall"}
+)
+
 #: The sentinel coordinator's own little gRPC service (ISSUE 4):
 #: ``Topology`` (client-facing: the current epoch/primary/replicas —
 #: SENTINEL get-master-addr parity), ``VoteDown`` (epoch-stamped
@@ -108,6 +141,14 @@ HA_METHODS = frozenset({"Promote", "ReplicaOf"})
 #: propagation), ``Ping`` (liveness).
 SENTINEL_SERVICE = "tpubloom.Sentinel"
 SENTINEL_METHODS = ("Ping", "Topology", "VoteDown", "AnnounceTopology")
+
+#: Sentinel server-streaming RPCs (ISSUE 9 satellite): ``TopologyEvents``
+#: pushes the cluster view to subscribed clients — one ``{kind:
+#: "topology", epoch, primary, replicas}`` frame on subscribe and on
+#: every change, ``{kind: "heartbeat", epoch}`` while idle — so
+#: topology-aware clients re-point on failover without waiting for a
+#: refresh-on-error round trip.
+SENTINEL_STREAM_METHODS = ("TopologyEvents",)
 
 
 def sentinel_method_path(method: str) -> str:

@@ -107,6 +107,24 @@ parity):
 * a quorum-acked write is by construction on the most-caught-up
   replica, which is exactly the sentinel's promotion pick — so it
   survives a primary SIGKILL *without* the client rid re-drive.
+
+Cluster mode (ISSUE 9 — :mod:`tpubloom.cluster`, Redis Cluster parity):
+
+* **slot ownership on every keyed RPC** — with ``--cluster`` a
+  :class:`tpubloom.cluster.ClusterState` is attached and the wrapper
+  checks ``key_slot(req["name"])`` before the handler: unowned slots
+  answer ``MOVED <slot> <addr>``, migrating slots answer ``ASK`` for
+  filters already handed off, importing slots serve only
+  ``asking``-flagged requests, unassigned slots answer ``CLUSTERDOWN``;
+* **live slot migration** — ``MigrateSlot`` streams each filter's
+  snapshot blob + op-log tail to the new owner (the PR-3/5 resync
+  machinery node→node) with a dual-write window: after the snapshot,
+  every committed mutating RPC on a migrating filter forwards to the
+  target (original rid + source seq) BEFORE the client is acked, and
+  the target's seq gate + rid dedup make re-deliveries exactly-once;
+* **map admin** — ``ClusterSlots`` (client bootstrap), ``ClusterSetSlot``
+  (marks + config-epoch-guarded ownership flips), driven by
+  ``python -m tpubloom.cluster`` (init / migrate / rebalance).
 """
 
 from __future__ import annotations
@@ -129,6 +147,9 @@ from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.obs import context as obs
 from tpubloom.obs.slowlog import Slowlog, summarize_request
+from tpubloom.cluster import migrate as cluster_migrate
+from tpubloom.cluster import node as cluster_node
+from tpubloom.cluster import slots as cluster_slots
 from tpubloom.repl import monitor as repl_monitor
 from tpubloom.repl import primary as repl_primary
 from tpubloom.repl.replica import FullResyncNeeded
@@ -176,9 +197,13 @@ class _Managed:
 #: timeout, so under overload it must count against --max-in-flight and
 #: shed like any data-plane call (Redis WAIT is a normal command too) —
 #: unsheddable Waits could exhaust the whole pool and starve Health.
+#: The cluster verbs (ISSUE 9) are control plane like the HA verbs: a
+#: shed ClusterSlots blinds clients mid-redirect storm, and a shed
+#: migration hop wedges a rebalance exactly when load made it urgent.
 UNSHEDDABLE = frozenset(
     {"Health", "ListFilters", "SlowlogGet", "SlowlogReset",
-     "Promote", "ReplicaOf"}
+     "Promote", "ReplicaOf",
+     "ClusterSlots", "ClusterSetSlot", "MigrateSlot", "MigrateInstall"}
 )
 
 #: How long after the last shed Health keeps reporting the "shedding"
@@ -221,6 +246,7 @@ class BloomService:
         listen_address: Optional[str] = None,
         min_replicas_to_write: int = 0,
         min_replicas_max_lag_ms: int = DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
+        cluster=None,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -283,6 +309,15 @@ class BloomService:
         self.primary_address: Optional[str] = None
         #: True while replay_oplog runs — replayed ops must not re-append
         self._replaying = False
+        #: per-thread record-seq hint for handlers invoked via
+        #: apply_record (replay / replica stream apply): ``_log_op``
+        #: returns None there, but the response a handler caches in the
+        #: rid-dedup MUST still carry the record's original ``repl_seq``
+        #: — a dedup-replayed answer without it would e.g. forward a
+        #: migration dual-write WITHOUT its ``src_seq``, bypassing the
+        #: target's exactly-once gate (a real double-apply, found by the
+        #: SIGKILL chaos test)
+        self._apply_seq_hint = threading.local()
         self._appends_since_truncate = 0
         # -- high availability (ISSUE 4) --
         #: topology epoch (Raft-term discipline): bumped+persisted at
@@ -322,6 +357,11 @@ class BloomService:
         #: must still log (become_replica drains those before attaching
         #: the applier), or its ack silently vanishes from the log.
         self._stream_fed = read_only
+        #: cluster mode (ISSUE 9): a
+        #: :class:`tpubloom.cluster.ClusterState` — slot map, ownership
+        #: checks, migration forwards. None = single-shard (the
+        #: pre-cluster behavior, no per-request overhead).
+        self.cluster = cluster
         #: set (repr of the exception) when an op-log append fails AFTER
         #: its op applied in memory — state is now ahead of the log, so
         #: further writes are fail-stopped (Redis aborts writes on AOF
@@ -612,6 +652,109 @@ class BloomService:
             )
         return promotion.become_replica(self, primary, epoch=req.get("epoch"))
 
+    # -- cluster mode: slot map, migration (ISSUE 9) -------------------------
+
+    def _require_cluster(self):
+        if self.cluster is None:
+            raise protocol.BloomServiceError(
+                "CLUSTER_DISABLED",
+                "this server is not running in cluster mode (start it "
+                "with --cluster)",
+            )
+        return self.cluster
+
+    def ClusterSlots(self, req: dict) -> dict:
+        """Redis ``CLUSTER SLOTS`` parity: the node's slot-map view —
+        what cluster clients build their slot→shard cache from. A
+        non-cluster server answers ``enabled: false`` so mixed fleets
+        stay probeable."""
+        if self.cluster is None:
+            return {"ok": True, "enabled": False, "epoch": 0, "ranges": []}
+        return {"ok": True, "enabled": True, **self.cluster.describe()}
+
+    def ClusterSetSlot(self, req: dict) -> dict:
+        """Redis ``CLUSTER SETSLOT`` parity plus the bulk ``assign``
+        form (see :meth:`tpubloom.cluster.ClusterState.set_slot`)."""
+        return self._require_cluster().set_slot(req)
+
+    def MigrateSlot(self, req: dict) -> dict:
+        """Drive the live migration of one slot to ``target`` (source
+        side; synchronous like Redis ``MIGRATE``)."""
+        self._require_cluster()
+        if self.read_only:
+            raise protocol.BloomServiceError(
+                "READONLY", "MigrateSlot must run on the shard primary"
+            )
+        return cluster_migrate.migrate_slot(
+            self, int(req["slot"]), req.get("target")
+        )
+
+    def MigrateInstall(self, req: dict) -> dict:
+        """Target side of a slot migration: adopt one filter's snapshot
+        blob for an importing slot (or answer a resume probe). The
+        ``src_seq`` stamp seeds the exactly-once import gate the
+        dual-write forwards are checked against."""
+        cluster = self._require_cluster()
+        if self.read_only:
+            raise protocol.BloomServiceError(
+                "READONLY", "MigrateInstall must run on the shard primary"
+            )
+        faults.fire("cluster.migrate_apply")
+        name = req["name"]
+        slot = cluster_slots.key_slot(name)
+        if not cluster.is_importing(slot):
+            raise protocol.BloomServiceError(
+                "NOT_IMPORTING",
+                f"slot {slot} is not importing on this node — mark it "
+                f"with ClusterSetSlot first",
+                details={"slot": slot},
+            )
+        if req.get("probe"):
+            base = cluster.gate_base(name)
+            have = base if (name in self._filters and base is not None) else None
+            return {"ok": True, "have": have}
+        src_seq = int(req["src_seq"])
+        self.install_migrated(name, req["blob"])
+        cluster.seed_gate(name, src_seq)
+        self.metrics.count("cluster_migrate_installs")
+        return {"ok": True, "name": name, "src_seq": src_seq}
+
+    def install_migrated(self, name: str, blob: bytes) -> None:
+        """Adopt a migrating filter's snapshot on the new owner. Unlike
+        the replica-side :meth:`install_snapshot`, this runs on a
+        PRIMARY: the create is op-logged with a ``restored_seq`` marker
+        — this shard's replicas cannot rebuild the blob's bytes from
+        records, so applying that record full-resyncs them (the PR-3
+        machinery), which carries the installed state."""
+        filt = ckpt.restore_blob(blob)
+        config = (
+            filt.base_config if hasattr(filt, "layers") else filt.config
+        )
+        sink = self._sink_factory(config)
+        mf = _Managed(filt, sink, getattr(config, "checkpoint_every", 0))
+        create_req = self._manifest_req_for(name, filt)
+        with self._lock:
+            old = self._filters.pop(name, None)
+            # log BEFORE publishing (same rule as CreateFilter): a
+            # concurrent forward on the new filter must not log below
+            # the create record's seq
+            self._log_op(
+                "CreateFilter",
+                {**create_req, "exist_ok": True, "restored_seq": -1},
+                mf,
+                may_truncate=False,
+            )
+            self._filters[name] = mf
+            self._manifest_put(name, create_req)
+        if old is not None and old.checkpointer:
+            old.checkpointer.close(final_checkpoint=False)
+        if mf.checkpointer:
+            # seed a durable generation NOW: this node's restart replay
+            # can only rebuild the filter from a local checkpoint — the
+            # blob's bytes exist in no record stream
+            with mf.lock:
+                mf.checkpointer.trigger()
+
     # -- replication: op log, apply, snapshots (ISSUE 3) ---------------------
 
     def _log_op(
@@ -736,11 +879,14 @@ class BloomService:
         # crash replays the record past its own checkpoint
         prev = mf.applied_seq
         mf.applied_seq = seq
+        self._apply_seq_hint.seq = seq
         try:
             getattr(self, method)(req)
         except Exception:
             mf.applied_seq = prev
             raise
+        finally:
+            self._apply_seq_hint.seq = None
         return True
 
     def replay_oplog(self) -> dict:
@@ -923,6 +1069,8 @@ class BloomService:
         }
         if self.listen_address:
             resp["listen"] = self.listen_address
+        if self.cluster is not None:
+            resp["cluster"] = self.cluster.summary()
         if self.replica_applier is not None and self.read_only:
             resp["replication"] = self.replica_applier.status()
             if self.oplog is not None:  # chained: serves downstream too
@@ -1136,7 +1284,8 @@ class BloomService:
         snapshot carries the state)."""
         logged = {k: v for k, v in req.items()
                   if k not in ("rid", "min_replicas",
-                               "min_replicas_timeout_ms")}
+                               "min_replicas_timeout_ms",
+                               "asking", "src_seq", "epoch")}
         if restored is not None:
             logged["restored_seq"] = getattr(restored, "_restored_seq", None)
         seq = self._log_op("CreateFilter", logged, mf, may_truncate=False)
@@ -1297,7 +1446,8 @@ class BloomService:
                     "DropFilter",
                     {k: v for k, v in req.items()
                      if k not in ("rid", "min_replicas",
-                                  "min_replicas_timeout_ms")},
+                                  "min_replicas_timeout_ms",
+                                  "asking", "src_seq", "epoch")},
                     may_truncate=False,
                 )
                 self._manifest_remove(req["name"])
@@ -1371,6 +1521,10 @@ class BloomService:
             seq = self._log_op(
                 "InsertBatch", {"name": req["name"], "keys": req["keys"]}, mf
             )
+            if seq is None:
+                # apply path (replay / stream apply): echo the record's
+                # own seq so the dedup-cached response stays seq-stamped
+                seq = getattr(self._apply_seq_hint, "seq", None)
             if mf.checkpointer:
                 mf.checkpointer.notify_inserts(len(req["keys"]))
         self.metrics.count("keys_inserted", len(req["keys"]))
@@ -1441,6 +1595,8 @@ class BloomService:
             seq = self._log_op(
                 "DeleteBatch", {"name": req["name"], "keys": req["keys"]}, mf
             )
+        if seq is None:  # apply path: keep the dedup response seq-stamped
+            seq = getattr(self._apply_seq_hint, "seq", None)
         self.metrics.count("keys_deleted", len(req["keys"]))
         resp = {"ok": True, "n": len(req["keys"])}
         if seq is not None:
@@ -1631,16 +1787,109 @@ def _wrap(service: BloomService, method_name: str):
                             f"refresh your topology",
                             details={"epoch": service.epoch},
                         )
-                    resp = handler(req)
+                    # cluster slot-ownership check (ISSUE 9): MOVED /
+                    # ASK / CLUSTERDOWN redirects BEFORE the handler;
+                    # the importing side's seq gate short-circuits
+                    # re-delivered migration forwards (exactly-once)
+                    gate_dup = False
+                    src_seq = None
+                    if (
+                        service.cluster is not None
+                        and isinstance(req_name, str)
+                        and method_name in cluster_node.KEYED_METHODS
+                    ):
+                        service.cluster.check(
+                            req_name,
+                            asking=bool(req.get("asking")),
+                            exists=req_name in service._filters,
+                            primary_address=(
+                                service.primary_address
+                                if service.read_only
+                                else None
+                            ),
+                        )
+                        if (
+                            method_name in protocol.MUTATING_METHODS
+                            and req.get("asking")
+                            and req.get("src_seq") is not None
+                        ):
+                            if (
+                                service.cluster.is_importing(
+                                    cluster_slots.key_slot(req_name)
+                                )
+                                and service.cluster.gate_base(req_name)
+                                is None
+                            ):
+                                # importing but no gate yet: the
+                                # snapshot install is still in flight
+                                # (or was lost to a restart) — applying
+                                # now would land on state the install
+                                # is about to REPLACE, silently losing
+                                # the write. Refuse; the source's
+                                # forward fails and the client re-drives
+                                # under the same rid until the gate
+                                # exists.
+                                raise protocol.BloomServiceError(
+                                    "IMPORT_NOT_READY",
+                                    f"filter {req_name!r} has no import "
+                                    f"gate yet (snapshot install in "
+                                    f"flight) — retry",
+                                )
+                            # atomic claim: the tail replay and the live
+                            # dual-write may deliver the SAME record
+                            # concurrently — only one claim wins, the
+                            # other acks as a dup without re-applying
+                            faults.fire("cluster.migrate_apply")
+                            if service.cluster.gate_claim(
+                                req_name, int(req["src_seq"])
+                            ):
+                                src_seq = int(req["src_seq"])
+                            else:
+                                gate_dup = True
+                                service.metrics.count("cluster_forward_dups")
+                    if gate_dup:
+                        # the forwarded record is already contained here
+                        # (snapshot coverage / earlier delivery): ack
+                        # without re-applying. Prefer the dedup cache's
+                        # FULL response (an earlier delivery through the
+                        # handler cached it, presence bits and this
+                        # node's repl_seq included) over the bare ack.
+                        cached = service._dedup_get(req.get("rid"))
+                        resp = cached if cached is not None else {
+                            "ok": True,
+                            "migrate_dup": True,
+                            "n": len(req.get("keys") or ()),
+                        }
+                    else:
+                        try:
+                            resp = handler(req)
+                        except BaseException:
+                            if src_seq is not None:
+                                # the apply itself failed: the record is
+                                # NOT contained — a re-delivery must pass
+                                service.cluster.gate_unclaim(
+                                    req_name, src_seq
+                                )
+                            raise
                     # durability gate (ISSUE 5): block OUTSIDE every
                     # lock until the quorum acked this write's record;
                     # a dedup-cache replay re-enters here with the
                     # cached repl_seq and re-waits on the same record
+                    # (a barrier timeout does NOT unclaim: the apply
+                    # stands, only its quorum ack is missing)
                     if (
-                        method_name in protocol.MUTATING_METHODS
+                        not gate_dup
+                        and method_name in protocol.MUTATING_METHODS
                         and resp.get("ok")
                     ):
                         resp = service.commit_barrier(req, resp)
+                        if service.cluster is not None:
+                            # dual-write window (ISSUE 9): a mutating op
+                            # on a migrating filter must land on the
+                            # target BEFORE the client is acked
+                            resp = cluster_migrate.forward_op(
+                                service, method_name, req, resp
+                            )
                     # post-apply fault: the handler's effect landed but the
                     # response is "lost" — the case rid-dedup must absorb
                     faults.fire("rpc.post_handle")
@@ -1665,7 +1914,9 @@ def _wrap(service: BloomService, method_name: str):
                     )
                 )
             duration_s = time.perf_counter() - t0
-            service.metrics.observe_rpc(method_name, duration_s, rctx.phases)
+            service.metrics.observe_rpc(
+                method_name, duration_s, rctx.phases, rid=rctx.rid
+            )
             service.slowlog.record(
                 method=method_name,
                 duration_s=duration_s,
@@ -1764,10 +2015,7 @@ def build_server(
     generic = grpc.method_handlers_generic_handler(protocol.SERVICE, handlers)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
-        options=[
-            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-            ("grpc.max_send_message_length", 256 * 1024 * 1024),
-        ],
+        options=list(protocol.CHANNEL_OPTIONS),
     )
     server.add_generic_rpc_handlers((generic,))
     port = server.add_insecure_port(address)
@@ -1954,6 +2202,16 @@ def main(argv: Optional[list] = None) -> None:
         "NOT_ENOUGH_REPLICAS. Requires --repl-log-dir. Default 0 (async)",
     )
     parser.add_argument(
+        "--cluster",
+        action="store_true",
+        help="run in cluster mode (ISSUE 9, Redis Cluster parity): every "
+        "keyed RPC is checked against the hash-slot map (MOVED/ASK "
+        "redirects), the ClusterSlots/ClusterSetSlot/MigrateSlot verbs "
+        "are served, and the map persists beside the op log (or the "
+        "checkpoint dir). Seed assignments with `python -m "
+        "tpubloom.cluster init`",
+    )
+    parser.add_argument(
         "--min-replicas-max-lag-ms",
         type=int,
         default=DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
@@ -1979,6 +2237,17 @@ def main(argv: Optional[list] = None) -> None:
 
         oplog = OpLog(args.repl_log_dir, fsync=args.repl_fsync)
     announce = args.announce or f"127.0.0.1:{args.port}"
+    cluster_state = None
+    if args.cluster:
+        from tpubloom.cluster.node import ClusterState
+
+        cluster_state = ClusterState(
+            announce, state_dir=args.repl_log_dir or ckpt_dir
+        )
+        log.info(
+            "cluster mode: %s (map epoch %d)",
+            announce, cluster_state.epoch(),
+        )
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
@@ -1989,6 +2258,7 @@ def main(argv: Optional[list] = None) -> None:
         listen_address=announce,
         min_replicas_to_write=args.min_replicas_to_write,
         min_replicas_max_lag_ms=args.min_replicas_max_lag_ms,
+        cluster=cluster_state,
     )
     if oplog is not None:
         stats = service.replay_oplog()
@@ -2071,6 +2341,8 @@ def main(argv: Optional[list] = None) -> None:
         service.oplog.close()
     elif oplog is not None:
         oplog.close()
+    if service.cluster is not None:
+        service.cluster.close()
     if metrics_server is not None:
         metrics_server.close()
     log.info("drain complete; exiting")

@@ -82,6 +82,13 @@ class Redis
         #                    restart the driver transparently re-creates the
         #                    filter (the server restores its newest
         #                    checkpoint) and retries once.
+        #   :encoding      - "auto" (default) ships fixed-width key
+        #                    batches (every key the same byte length) as
+        #                    the zero-copy `fixed` wire encoding once a
+        #                    Health probe confirmed the server supports
+        #                    it (negotiated per-connection, re-probed
+        #                    after a failover re-point); "msgpack" pins
+        #                    the classic per-key list
         #   :min_replicas  - default durability quorum stamped on every
         #                    mutating call (Redis min-replicas-to-write
         #                    parity, ISSUE 5): the server blocks the call
@@ -100,6 +107,7 @@ class Redis
           @epoch = nil
           @min_replicas = opts[:min_replicas]
           @last_write_seq = nil
+          @encoding = opts[:encoding] || "auto"
           address = opts[:address] || "127.0.0.1:50051"
           if !@sentinels.empty? && (topo = fetch_topology)
             address = topo["primary"] || address
@@ -117,7 +125,7 @@ class Redis
           rpc(
             "InsertBatch",
             durability(
-              { "name" => @name, "keys" => keys.map(&:to_s) }, min_replicas
+              encode_keys({ "name" => @name }, keys), min_replicas
             )
           )
           true
@@ -146,7 +154,7 @@ class Redis
 
         # Returns an array of booleans, one per key.
         def include_batch?(keys)
-          resp = rpc("QueryBatch", "name" => @name, "keys" => keys.map(&:to_s))
+          resp = rpc("QueryBatch", encode_keys({ "name" => @name }, keys))
           unpack_bits(resp["hits"], resp["n"])
         end
 
@@ -186,6 +194,49 @@ class Redis
         def connect(address)
           @address = address
           @stub = GRPC::ClientStub.new(address, :this_channel_is_insecure)
+          # wire-encoding capability is per-CONNECTION (ISSUE 10): a
+          # failover re-point must re-probe the new primary
+          @fixed_negotiated = nil
+        end
+
+        # Lazy per-connection negotiation of the zero-copy `fixed` key
+        # encoding: one Health probe decides; probe failures degrade to
+        # msgpack for this connection, never an error.
+        def fixed_negotiated?
+          return false if @encoding == "msgpack"
+          if @fixed_negotiated.nil?
+            @fixed_negotiated =
+              begin
+                h = rpc_once("Health", {})
+                Array(h["encodings"]).include?("fixed")
+              rescue GRPC::BadStatus, ServiceError
+                false
+              end
+          end
+          @fixed_negotiated
+        end
+
+        # Fold the key batch into the payload under the best negotiated
+        # encoding (ISSUE 10): when every key is the SAME byte length
+        # and the server speaks `fixed`, the batch ships as one raw
+        # buffer ({data, width, n}) the server decodes zero-copy;
+        # anything else takes the classic msgpack list.
+        def encode_keys(payload, keys)
+          keys = keys.map(&:to_s)
+          # tiny batches gain nothing from the fixed encoding and would
+          # change the op-log record shape scalar calls produce — mirror
+          # the Python client's FIXED_LIST_MIN threshold
+          if keys.length >= 8 && fixed_negotiated?
+            width = keys.first.bytesize
+            if width.positive? && keys.all? { |k| k.bytesize == width }
+              payload["keys_fixed"] = {
+                "data" => keys.join.b, "width" => width, "n" => keys.length
+              }
+              return payload
+            end
+          end
+          payload["keys"] = keys
+          payload
         end
 
         # Ask each sentinel for the current cluster view; first answer

@@ -1,0 +1,664 @@
+"""ISSUE 10: cross-connection micro-batching ingestion scheduler.
+
+Covers the tentpole end to end:
+
+* concurrent-client **exactly-once** + per-request result **demux**
+  (presence slices, query hits, repl_seq) through coalesced flushes;
+* **barrier amortization**: one ``wait_acked`` per flush, per-request
+  quorum verdicts — a barrier timeout answers ``NOT_ENOUGH_REPLICAS``
+  per-request with ``applied: true`` while a weaker sibling in the SAME
+  flush succeeds; the dedup re-wait path stays intact;
+* the **fixed wire encoding**: zero-copy round trip, per-connection
+  negotiation, msgpack twins unaffected;
+* coalescer **chaos**: the ``ingest.coalesce`` / ``ingest.flush`` fault
+  points fire before anything applies, so retries stay exactly-once;
+* READONLY / DRAINING / MOVED semantics preserved with the coalescer on
+  (they run in the wrapper before anything parks);
+* satellites: forward-entry aging, per-slot traffic counters, phase
+  exemplars;
+* the tier-1 smoke wrapper over ``benchmarks/ingest_load.py``.
+
+The whole module runs under the armed lock tracker (``lock_check_armed``)
+and diffs the runtime acquisition graph against the declared manifest at
+teardown — the new ``ingest.*`` ranks are part of the ISSUE-10 surface.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpubloom import faults
+from tpubloom.obs import counters as obs_counters
+from tpubloom.server import protocol
+from tpubloom.server.client import BloomClient
+from tpubloom.server.ingest import CoalesceConfig
+from tpubloom.server.service import BloomService, build_server
+
+pytestmark = pytest.mark.usefixtures("lock_check_armed")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lock_order_manifest(lock_check_armed):
+    """The new ``ingest.*`` lock ranks must be DECLARED: after the whole
+    armed module ran, every runtime acquisition edge must be in the
+    lock-order manifest (ROADMAP item 7 discipline)."""
+    import glob
+    import json
+
+    from tpubloom.analysis import lock_order
+    from tpubloom.utils import locks
+
+    yield
+    findings = lock_order.check_live()
+    report_dir = os.environ.get(locks.REPORT_DIR_ENV, "")
+    if report_dir and os.path.isdir(report_dir):
+        for path in sorted(
+            glob.glob(os.path.join(report_dir, "lockcheck-*.json"))
+        ):
+            with open(path) as f:
+                findings.extend(
+                    {**v, "report": os.path.basename(path)}
+                    for v in lock_order.check_report(json.load(f))
+                )
+    assert not findings, (
+        "undeclared lock-order edges:\n"
+        + "\n".join(f"  {f['message']}" for f in findings)
+    )
+
+
+class _Server:
+    def __init__(self, service):
+        self.service = service
+        self.server, self.port = build_server(service, "127.0.0.1:0")
+        self.server.start()
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def client(self, **kw) -> BloomClient:
+        return BloomClient(self.addr, **kw)
+
+    def stop(self):
+        self.service.shutdown()
+        self.server.stop(grace=None)
+
+
+@pytest.fixture()
+def coalesced_server():
+    s = _Server(BloomService(
+        coalesce=CoalesceConfig(max_keys=4096, max_wait_us=2000)
+    ))
+    yield s
+    s.stop()
+
+
+def _threads(fns):
+    errs = []
+
+    def run(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(fn,)) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+
+
+# -- exactly-once + demux -----------------------------------------------------
+
+
+def test_concurrent_inserts_coalesce_exactly_once(coalesced_server):
+    """N clients' inserts coalesce into shared flushes; a counting
+    filter proves exactly-once (a double-applied insert survives one
+    delete round), and every client's keys land."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter(
+            "cnt", capacity=200_000, error_rate=0.01, counting=True
+        )
+
+        def writer(t):
+            def go():
+                with s.client() as c:
+                    for i in range(6):
+                        keys = [b"ek-%d-%d-%d" % (t, i, j) for j in range(40)]
+                        assert c.insert_batch("cnt", keys) == 40
+            return go
+
+        _threads([writer(t) for t in range(6)])
+        counters = admin.stats()["counters"]
+        assert counters["ingest_requests_coalesced"] >= 36
+        assert counters["ingest_flushes"] >= 1
+        all_keys = [
+            b"ek-%d-%d-%d" % (t, i, j)
+            for t in range(6) for i in range(6) for j in range(40)
+        ]
+        assert admin.include_batch("cnt", all_keys).all()
+        admin.delete_batch("cnt", all_keys)  # 1 - 1 = 0 unless doubled
+        doubled = int(admin.include_batch("cnt", all_keys).sum())
+        assert doubled == 0, f"{doubled} keys double-applied in a flush"
+
+
+def test_presence_demux_per_request(coalesced_server):
+    """return_presence through a coalesced flush: each request's slice
+    reflects ITS keys' pre-batch membership, not its flush-mates'."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter("pres", capacity=200_000, error_rate=0.01)
+        results = {}
+
+        def writer(t):
+            def go():
+                with s.client() as c:
+                    keys = [b"pk-%d-%d" % (t, j) for j in range(50)]
+                    results[t] = (
+                        c.insert_batch("pres", keys, return_presence=True),
+                        c.insert_batch("pres", keys, return_presence=True),
+                    )
+            return go
+
+        _threads([writer(t) for t in range(5)])
+        for t, (first, second) in results.items():
+            assert not first.any(), f"client {t}: fresh keys reported present"
+            assert second.all(), f"client {t}: re-insert lost its own keys"
+
+
+def test_query_demux_per_request(coalesced_server):
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter("q", capacity=200_000, error_rate=0.01)
+        present = [b"in-%d" % j for j in range(100)]
+        admin.insert_batch("q", present)
+        results = {}
+
+        def reader(t):
+            def go():
+                with s.client() as c:
+                    mine = [b"in-%d" % ((t * 7 + j) % 100) for j in range(20)]
+                    absent = [b"out-%d-%d" % (t, j) for j in range(20)]
+                    results[t] = (
+                        c.include_batch("q", mine),
+                        c.include_batch("q", absent),
+                    )
+            return go
+
+        _threads([reader(t) for t in range(6)])
+        for t, (hit, miss) in results.items():
+            assert hit.all(), f"client {t}: present keys demuxed wrong"
+            assert not miss.any(), f"client {t}: absent keys demuxed wrong"
+
+
+# -- fixed wire encoding ------------------------------------------------------
+
+
+def test_fixed_encoding_round_trip_and_negotiation(coalesced_server):
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("fx", capacity=100_000, error_rate=0.01)
+        keys = np.arange(1000, 2000, dtype=np.uint64)
+        assert c.insert_batch("fx", keys) == 1000
+        assert c._fixed_negotiated is True  # negotiated off Health
+        assert c.include_batch("fx", keys).all()
+        # msgpack twins: the SAME u64s as 8-byte little-endian bins must
+        # hit through a pinned-msgpack client, and vice versa
+        with s.client(encoding="msgpack") as m:
+            twins = [int(k).to_bytes(8, "little") for k in keys[:50]]
+            assert m.include_batch("fx", twins).all()
+            m.insert_batch("fx", [b"mp-only-1", b"mp-only-2"])
+        assert c.include_batch(
+            "fx", np.arange(5000, 5100, dtype=np.uint64)
+        ).sum() <= 2  # fpr-level noise only
+        # equal-width bytes lists ship fixed too
+        wide = [b"W%015d" % j for j in range(64)]  # 16B == key_len
+        c.insert_batch("fx", wide)
+        assert c.include_batch("fx", wide).all()
+        # keys WIDER than key_len (16) must fall back to msgpack so
+        # key_policy applies — here policy=error, so the server errors
+        # identically to the classic path
+        with pytest.raises(protocol.BloomServiceError):
+            c.insert_batch("fx", [b"x" * 32, b"y" * 32])
+
+
+def test_wide_fixed_keys_fall_back_to_key_policy_in_flush(coalesced_server):
+    """Fixed-width keys WIDER than key_len arriving at a coalesced
+    flush must take the list path so key_policy (digest) applies —
+    direct-path parity, not an INTERNAL from the packed staging."""
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter(
+            "wide", capacity=100_000, error_rate=0.01, key_policy="digest"
+        )
+        wide = [bytes([j]) * 32 for j in range(16)]  # 32B > key_len 16
+        assert c.insert_batch("wide", wide) == 16
+        assert c.include_batch("wide", wide).all()
+        absent = [bytes([200 + j]) * 32 for j in range(10)]
+        assert not c.include_batch("wide", absent).any()
+
+
+def test_fixed_encoding_replicates_and_replays(tmp_path):
+    """A fixed-encoded insert's op-log record replays after restart —
+    the record carries the raw buffer, and the handler applies it on
+    the replay path exactly once."""
+    from tpubloom.repl import OpLog
+
+    d = str(tmp_path / "log")
+    svc = BloomService(oplog=OpLog(d))
+    s = _Server(svc)
+    keys = np.arange(500, dtype=np.uint64)
+    with s.client() as c:
+        c.create_filter("r", capacity=100_000, error_rate=0.01)
+        c.insert_batch("r", keys)
+    s.stop()
+    svc.oplog.close()
+
+    svc2 = BloomService(oplog=OpLog(d))
+    stats = svc2.replay_oplog()
+    assert stats["failed"] == 0 and stats["applied"] >= 2
+    s2 = _Server(svc2)
+    with s2.client() as c:
+        assert c.include_batch("r", keys).all()
+    s2.stop()
+    svc2.oplog.close()
+
+
+# -- barrier amortization -----------------------------------------------------
+
+
+def test_flush_shares_one_barrier_with_per_request_verdicts(tmp_path):
+    """Two writes in ONE flush, different quorums: the flush runs ONE
+    wait (one wait_barrier observation), the min_replicas=1 request
+    times out with NOT_ENOUGH_REPLICAS {applied: true, seq}, the
+    min_replicas=0 sibling succeeds — and after the replica acks, a
+    same-rid re-drive answers from the dedup cache and re-waits to
+    success."""
+    from tpubloom.repl import OpLog
+
+    svc = BloomService(
+        oplog=OpLog(str(tmp_path / "log")),
+        # size-ripe at exactly the two parked requests: 2 x 64 keys
+        coalesce=CoalesceConfig(max_keys=128, max_wait_us=500_000),
+    )
+    # a CONNECTED (but silent) replica session: the barrier must ride
+    # out its budget instead of fail-fasting
+    sid = svc.repl_sessions.register("silent-replica", listen="127.0.0.1:1")
+    s = _Server(svc)
+    try:
+        with s.client() as admin:
+            # counting => replay-unsafe => the rid-dedup cache holds the
+            # seq-stamped response the re-drive below re-waits through
+            admin.create_filter(
+                "b", capacity=100_000, error_rate=0.01, counting=True
+            )
+            waits_before = svc.metrics.waits.n
+            keys_a = [b"qa-%d" % j for j in range(64)]
+            keys_b = [b"qb-%d" % j for j in range(64)]
+            outcome = {}
+
+            def strict():
+                with s.client() as c:
+                    try:
+                        c.insert_batch(
+                            "b", keys_a,
+                            min_replicas=1, min_replicas_timeout_ms=300,
+                        )
+                        outcome["strict"] = "ok"
+                    except protocol.BloomServiceError as e:
+                        outcome["strict"] = e
+                    outcome["strict_rid"] = c.last_rid
+
+            def lax():
+                with s.client() as c:
+                    outcome["lax"] = c.insert_batch("b", keys_b)
+
+            _threads([strict, lax])
+            err = outcome["strict"]
+            assert isinstance(err, protocol.BloomServiceError), (
+                "the quorum-demanding write must time out (no acks)"
+            )
+            assert err.code == "NOT_ENOUGH_REPLICAS"
+            assert err.details["applied"] is True
+            assert err.details["acked"] == 0
+            seq = err.details["seq"]
+            assert isinstance(seq, int)
+            assert outcome["lax"] == 64, "the min_replicas=0 sibling failed"
+            # ONE barrier observation covered the whole flush
+            assert svc.metrics.waits.n == waits_before + 1
+            # the apply stands: both batches are readable
+            assert admin.include_batch("b", keys_a + keys_b).all()
+
+            # ack the flush's record, then re-drive the strict write
+            # under its ORIGINAL rid: dedup answers the cached
+            # (seq-stamped) response and the wrapper re-waits — success
+            svc.repl_sessions.ack(sid, seq)
+            with s.client() as c:
+                resp = c._rpc(
+                    "InsertBatch",
+                    {"name": "b", "keys": keys_a, "min_replicas": 1,
+                     "min_replicas_timeout_ms": 1000},
+                    rid=outcome["strict_rid"],
+                )
+            assert resp["repl_seq"] == seq, (
+                "the re-drive must answer from the dedup cache (a fresh "
+                "apply would mint a NEW record seq)"
+            )
+            assert resp["acked_replicas"] == 1
+            assert svc.metrics.counters["insert_dedup_hits"] >= 1
+            # exactly-once under the re-drive: still present, one apply
+            admin.create_filter(  # bare attach, sanity that nothing broke
+                "b", exist_ok=True
+            )
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
+def test_quorum_required_without_oplog_in_flush(coalesced_server):
+    """min_replicas on a log-less server answers NOT_ENOUGH_REPLICAS
+    from the coalesced path too (the direct path's contract)."""
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("nolog", capacity=100_000, error_rate=0.01)
+        with pytest.raises(protocol.BloomServiceError) as ei:
+            c.insert_batch("nolog", [b"k1"], min_replicas=1)
+        assert ei.value.code == "NOT_ENOUGH_REPLICAS"
+        assert ei.value.details["applied"] is True
+
+
+# -- chaos --------------------------------------------------------------------
+
+
+def test_ingest_flush_fault_fails_flush_then_heals(coalesced_server):
+    """An injected ingest.flush fault fires BEFORE anything applies:
+    every parked request errors, nothing lands, and the retry applies
+    exactly once (counting-filter delete proof)."""
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter(
+            "chaos", capacity=100_000, error_rate=0.01, counting=True
+        )
+        keys = [b"cf-%d" % j for j in range(32)]
+        faults.arm("ingest.flush", "once")
+        with pytest.raises(protocol.BloomServiceError) as ei:
+            c.insert_batch("chaos", keys)
+        assert ei.value.code == "INTERNAL"
+        assert not c.include_batch("chaos", keys).any(), (
+            "a failed flush must not have applied"
+        )
+        assert c.insert_batch("chaos", keys) == 32  # heals
+        c.delete_batch("chaos", keys)
+        assert not c.include_batch("chaos", keys).any(), "double-applied"
+
+
+def test_ingest_coalesce_fault_fires_pre_park(coalesced_server):
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("chaos2", capacity=100_000, error_rate=0.01)
+        faults.arm("ingest.coalesce", "once")
+        with pytest.raises(protocol.BloomServiceError):
+            c.insert_batch("chaos2", [b"x"])
+        assert not c.include_batch("chaos2", [b"x"]).any()
+        assert c.insert_batch("chaos2", [b"x"]) == 1
+
+
+# -- admission/routing semantics preserved ------------------------------------
+
+
+def test_readonly_preserved_with_coalescer():
+    svc = BloomService(
+        read_only=True,
+        coalesce=CoalesceConfig(max_keys=4096, max_wait_us=1000),
+    )
+    s = _Server(svc)
+    try:
+        with s.client() as c:
+            with pytest.raises(protocol.BloomServiceError) as ei:
+                c._rpc("InsertBatch", {"name": "x", "keys": [b"k"]})
+            assert ei.value.code == "READONLY"
+    finally:
+        s.stop()
+
+
+def test_draining_shed_preserved_with_coalescer(coalesced_server):
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("drain", capacity=100_000, error_rate=0.01)
+        s.service.begin_drain()
+        with pytest.raises(protocol.BloomServiceError) as ei:
+            c._rpc("InsertBatch", {"name": "drain", "keys": [b"k"]})
+        assert ei.value.code == "DRAINING"
+
+
+def test_moved_preserved_with_coalescer(tmp_path):
+    """Cluster slot checks run BEFORE the handler parks anything: an
+    unowned slot answers MOVED even with the coalescer armed."""
+    from tpubloom.cluster.node import ClusterState
+    from tpubloom.repl import OpLog
+
+    cluster = ClusterState("127.0.0.1:7100", state_dir=str(tmp_path))
+    cluster.set_slot({"assign": [[0, 16383, "127.0.0.1:9999"]], "epoch": 1})
+    svc = BloomService(
+        oplog=OpLog(str(tmp_path / "log")),
+        cluster=cluster,
+        coalesce=CoalesceConfig(max_keys=4096, max_wait_us=1000),
+    )
+    s = _Server(svc)
+    try:
+        with s.client() as c:
+            with pytest.raises(protocol.BloomServiceError) as ei:
+                c._rpc("InsertBatch", {"name": "elsewhere", "keys": [b"k"]})
+            assert ei.value.code == "MOVED"
+            assert ei.value.details["addr"] == "127.0.0.1:9999"
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+def test_forward_entries_age_out_after_handoff(tmp_path):
+    """ROADMAP 1(d): dual-write forward entries expire a TTL after the
+    slot handoff finalizes — within the TTL stragglers still forward."""
+    from tpubloom.cluster import slots as slots_mod
+    from tpubloom.cluster.node import ClusterState
+
+    cs = ClusterState(
+        "127.0.0.1:7200", state_dir=str(tmp_path), forward_ttl_s=0.15
+    )
+    name = "aging-filter"
+    slot = slots_mod.key_slot(name)
+    cs.set_slot({"assign": [[0, 16383, "127.0.0.1:7200"]], "epoch": 1})
+    cs.set_slot({"slot": slot, "state": "migrating", "addr": "127.0.0.1:7201"})
+    cs.begin_forwarding(name, "127.0.0.1:7201")
+    assert cs.forward_target(name) == "127.0.0.1:7201"
+    before = obs_counters.get("cluster_forward_entries_expired")
+    # finalize AWAY: the retirement clock starts, stragglers still served
+    cs.set_slot(
+        {"slot": slot, "state": "node", "addr": "127.0.0.1:7201", "epoch": 2}
+    )
+    assert cs.forward_target(name) == "127.0.0.1:7201", (
+        "within the TTL a straggling in-flight write must still forward"
+    )
+    time.sleep(0.2)
+    assert cs.forward_target(name) is None, "entry must expire past the TTL"
+    assert obs_counters.get("cluster_forward_entries_expired") == before + 1
+    # re-arming resets the clock (a re-driven migration)
+    cs.begin_forwarding(name, "127.0.0.1:7202")
+    assert cs.forward_target(name) == "127.0.0.1:7202"
+
+
+def test_finalize_back_to_self_drops_forwards(tmp_path):
+    from tpubloom.cluster import slots as slots_mod
+    from tpubloom.cluster.node import ClusterState
+
+    cs = ClusterState("127.0.0.1:7300", state_dir=str(tmp_path))
+    name = "come-back"
+    slot = slots_mod.key_slot(name)
+    cs.set_slot({"assign": [[0, 16383, "127.0.0.1:7300"]], "epoch": 1})
+    cs.begin_forwarding(name, "127.0.0.1:7301")
+    cs.set_slot(
+        {"slot": slot, "state": "node", "addr": "127.0.0.1:7300", "epoch": 2}
+    )
+    assert cs.forward_target(name) is None
+
+
+def test_slot_traffic_counters(tmp_path):
+    """Per-slot key-traffic counters (ROADMAP item 6): keyed RPCs on a
+    cluster node mint cluster_slot_keys_total_<slot> by key count."""
+    from tpubloom.cluster import slots as slots_mod
+    from tpubloom.cluster.node import ClusterState
+    from tpubloom.repl import OpLog
+
+    addr = "127.0.0.1:7400"
+    cluster = ClusterState(addr, state_dir=str(tmp_path))
+    cluster.set_slot({"assign": [[0, 16383, addr]], "epoch": 1})
+    svc = BloomService(oplog=OpLog(str(tmp_path / "log")), cluster=cluster)
+    s = _Server(svc)
+    try:
+        with s.client() as c:
+            name = "traffic"
+            slot = slots_mod.key_slot(name)
+            series = f"cluster_slot_keys_total_{slot}"
+            before = obs_counters.get(series)
+            c.create_filter(name, capacity=100_000, error_rate=0.01)
+            c.insert_batch(name, [b"t-%d" % j for j in range(37)])
+            c.include_batch(name, [b"t-%d" % j for j in range(11)])
+            assert obs_counters.get(series) == before + 37 + 11
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
+def test_phase_histogram_exemplars(coalesced_server):
+    """ROADMAP item 6 leftover: the per-RPC phase histograms carry
+    rid exemplars, rendered behind /metrics?exemplars=1."""
+    from tpubloom.obs.exposition import render_service
+
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("ex", capacity=100_000, error_rate=0.01)
+        c.insert_batch("ex", [b"e-%d" % j for j in range(10)])
+        rid = c.last_rid
+    text = render_service(s.service, exemplars=True)
+    phase_lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith("tpubloom_rpc_phase_seconds_bucket") and "# {rid=" in ln
+    ]
+    assert phase_lines, "phase buckets must carry exemplars"
+    assert any(rid in ln for ln in phase_lines), (
+        "the newest request's rid must be findable in a phase exemplar"
+    )
+    # stock scrape untouched
+    plain = render_service(s.service, exemplars=False)
+    assert "# {rid=" not in plain
+
+
+# -- drain/demotion interplay -------------------------------------------------
+
+
+def test_shutdown_completes_parked_requests():
+    """Drain semantics: requests parked at shutdown complete normally
+    (their writers were admitted before the drain began)."""
+    svc = BloomService(
+        coalesce=CoalesceConfig(max_keys=1 << 20, max_wait_us=300_000)
+    )
+    s = _Server(svc)
+    with s.client() as c:
+        c.create_filter("park", capacity=100_000, error_rate=0.01)
+        got = {}
+
+        def writer():
+            with s.client() as w:
+                got["n"] = w.insert_batch("park", [b"p-%d" % j for j in range(8)])
+
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.1)  # let it park (flush deadline is 300ms away)
+        svc._coalescer.close()  # the drain path flushes parked entries
+        t.join(timeout=10)
+        assert not t.is_alive() and got.get("n") == 8
+        # post-close submissions fall back to the direct path
+        assert c.insert_batch("park", [b"direct"]) == 1
+    s.stop()
+
+
+def test_demotion_drains_parked_coalesced_writes(tmp_path):
+    """A write PARKED in the coalescer passed the READONLY fence but
+    holds no filter lock — ``become_replica``'s take-every-lock barrier
+    alone would miss it. The drain hook must flush it into the OLD seq
+    space before the applier takes the log over (an acked write must
+    never vanish from the log across a demotion)."""
+    from tpubloom.ha.promotion import become_replica
+    from tpubloom.repl import OpLog
+
+    svc = BloomService(
+        oplog=OpLog(str(tmp_path / "log")),
+        # flush deadline far away: the demotion must not wait it out
+        coalesce=CoalesceConfig(max_keys=1 << 20, max_wait_us=400_000),
+    )
+    s = _Server(svc)
+    try:
+        with s.client() as c:
+            c.create_filter("d", capacity=100_000, error_rate=0.01)
+            got = {}
+
+            def writer():
+                with s.client() as w:
+                    got["n"] = w.insert_batch(
+                        "d", [b"parked-%d" % j for j in range(4)]
+                    )
+
+            t = threading.Thread(target=writer)
+            t.start()
+            time.sleep(0.15)  # let it park
+            become_replica(svc, "127.0.0.1:1")  # demote NOW
+            t.join(timeout=10)
+            assert got.get("n") == 4, "the parked write must complete"
+            logged_keys = {
+                k
+                for r in svc.oplog.read_from(0)
+                if r["method"] == "InsertBatch"
+                for k in r["req"].get("keys", [])
+            }
+            assert b"parked-0" in logged_keys, (
+                "the drained flush must have LOGGED before the applier "
+                "took the log over"
+            )
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
+# -- tier-1 smoke over the load generator -------------------------------------
+
+
+def test_ingest_load_smoke():
+    """The ISSUE-10 acceptance bench: N coalesced connections beat one
+    connection >= 2x AND the quorum run amortizes barriers across
+    flushes (asserted inside run_load)."""
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks"),
+    )
+    import ingest_load
+
+    out = ingest_load.run_load(duration_s=1.5)
+    assert out["aggregate_keys_per_sec"] > out["single_conn_keys_per_sec"]
+    assert out["wait_barrier_observations"] < out["quorum_write_requests"]

@@ -40,6 +40,17 @@ GOLDEN = {
         "InsertBatch",
         "83a46e616d65a6676f6c64656ea46b65797392c4040001feffa8746578742d6b6579af72657475726e5f70726573656e6365c3",
     ),
+    # fixed wire encoding (ISSUE 10): two u64 keys (1, 2) as ONE raw
+    # little-endian buffer, and a query of {1, 2, 999999} the same way —
+    # the exact bytes the negotiated Ruby/Python clients produce
+    "InsertBatch_fixed": (
+        "InsertBatch",
+        "82a46e616d65a6676f6c64656eaa6b6579735f666978656483a464617461c41001000000000000000200000000000000a5776964746808a16e02",
+    ),
+    "QueryBatch_fixed": (
+        "QueryBatch",
+        "82a46e616d65a6676f6c64656eaa6b6579735f666978656483a464617461c418010000000000000002000000000000003f420f0000000000a5776964746808a16e03",
+    ),
     "QueryBatch": (
         "QueryBatch",
         "82a46e616d65a6676f6c64656ea46b65797393c4040001feffa8746578742d6b6579a6616273656e74",
@@ -113,6 +124,21 @@ GOLDEN_DICTS = {
     "InsertBatch_presence": {"name": "golden",
                              "keys": [b"\x00\x01\xfe\xff", "text-key"],
                              "return_presence": True},
+    "InsertBatch_fixed": {
+        "name": "golden",
+        "keys_fixed": {
+            "data": (1).to_bytes(8, "little") + (2).to_bytes(8, "little"),
+            "width": 8, "n": 2,
+        },
+    },
+    "QueryBatch_fixed": {
+        "name": "golden",
+        "keys_fixed": {
+            "data": (1).to_bytes(8, "little") + (2).to_bytes(8, "little")
+            + (999999).to_bytes(8, "little"),
+            "width": 8, "n": 3,
+        },
+    },
     "QueryBatch": {"name": "golden",
                    "keys": [b"\x00\x01\xfe\xff", "text-key", "absent"]},
     "InsertBatch_cnt": {"name": "golden-cnt", "keys": [b"ck-1", b"ck-2"]},
@@ -217,6 +243,29 @@ def test_golden_replay_against_live_server(raw_server):
     assert r["ok"] and r["n"] == 3 and isinstance(r["hits"], bytes)
     bits = np.unpackbits(np.frombuffer(r["hits"], np.uint8), bitorder="big")[:3]
     assert bits[0] and bits[1] and not bits[2]
+
+    # fixed wire encoding (ISSUE 10): the raw-buffer insert round-trips
+    # through the raw-buffer query AND through the msgpack twin — a u64
+    # shipped fixed must hit the same positions as its 8-byte bin form
+    r = _call(ch, *GOLDEN["InsertBatch_fixed"])
+    assert r["ok"] and r["n"] == 2
+    r = _call(ch, *GOLDEN["QueryBatch_fixed"])
+    assert r["ok"] and r["n"] == 3
+    bits = np.unpackbits(np.frombuffer(r["hits"], np.uint8), bitorder="big")[:3]
+    assert bits[0] and bits[1] and not bits[2]
+    twin = msgpack.packb(
+        {"name": "golden",
+         "keys": [(1).to_bytes(8, "little"), (2).to_bytes(8, "little")]},
+        use_bin_type=True,
+    )
+    fn = ch.unary_unary(
+        protocol.method_path("QueryBatch"),
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    r = msgpack.unpackb(fn(twin), raw=False)
+    bits = np.unpackbits(np.frombuffer(r["hits"], np.uint8), bitorder="big")[:2]
+    assert bits.all(), "fixed-inserted keys must hit via the msgpack twin"
 
     assert _call(ch, *GOLDEN["InsertBatch_cnt"])["ok"]
     assert _call(ch, *GOLDEN["DeleteBatch"])["ok"]

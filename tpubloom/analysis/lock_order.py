@@ -108,6 +108,10 @@ ALLOWED_EDGES = frozenset(
         ("service.promote", "repl.ack_sender"),
         ("service.promote", "ckpt.trigger"),
         ("service.promote", "obs.counters"),
+        # become_replica counts ha_demotions while still holding the
+        # promote lock (pre-existing; first DIFFED by test_ingest's
+        # in-process demotion test — test_ha demotes subprocesses)
+        ("service.promote", "obs.metrics"),
         ("service.promote", "faults.registry"),
         # primary-side streaming reads sessions + log state
         ("repl.sessions", "repl.oplog"),
@@ -119,6 +123,15 @@ ALLOWED_EDGES = frozenset(
         ("filter.op", "cluster.state"),
         ("service.registry", "cluster.state"),
         ("cluster.client", "client.breaker"),
+        # -- ingestion coalescer (ISSUE 10): the queue condition is a
+        #    LEAF apart from the parked-keys gauge — the dispatcher
+        #    drops it before touching any filter/registry/log lock, and
+        #    the flush itself mints only the existing filter.op edges
+        ("ingest.queue", "obs.counters"),
+        # the demotion barrier drains parked coalesced writes under the
+        # promote lock (become_replica — see ingest.drain_parked, which
+        # deliberately POLLS instead of waiting on the condition)
+        ("service.promote", "ingest.queue"),
     }
 )
 

@@ -40,6 +40,7 @@ forward under a filter or registry lock is a lint/runtime finding.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import grpc
@@ -77,19 +78,38 @@ KEYED_METHODS = frozenset(
 #: re-driven — and the whole gate drops at handoff finalize anyway.
 GATE_SEEN_MAX = 65536
 
+#: How long a dual-write forward entry outlives its slot's handoff
+#: (ISSUE 10 satellite, ROADMAP 1(d)). Entries must linger PAST the
+#: finalize — straggling in-flight writes that raced the ownership flip
+#: still forward through them — but before this, they lingered forever
+#: and grew without bound on slot churn. After the TTL a forward for a
+#: finalized slot answers MOVED at this node anyway (ownership already
+#: flipped), so expiry loses nothing.
+FORWARD_TTL_S = 60.0
+
 _CHANNEL_OPTIONS = list(protocol.CHANNEL_OPTIONS)
 
 
 class ClusterState:
     """Slot map + migration bookkeeping for one cluster node."""
 
-    def __init__(self, self_addr: str, state_dir: Optional[str] = None):
+    def __init__(
+        self,
+        self_addr: str,
+        state_dir: Optional[str] = None,
+        *,
+        forward_ttl_s: float = FORWARD_TTL_S,
+    ):
         self.self_addr = self_addr
         self._lock = locks.named_lock("cluster.state")
         self._store = slots_mod.SlotStore(state_dir) if state_dir else None
         self.slots = (self._store.load() if self._store else None) or slots_mod.SlotMap()
         #: filter name -> target addr: dual-write forwards (source side)
         self._forwarding: dict = {}
+        #: filter name -> monotonic time its slot's handoff finalized;
+        #: entries older than ``forward_ttl_s`` past that moment expire
+        self._forward_retired: dict = {}
+        self.forward_ttl_s = float(forward_ttl_s)
         #: filter name -> {"base": int, "seen": set} (target side)
         self._gates: dict = {}
         self._channels: dict = {}
@@ -280,6 +300,17 @@ class ClusterState:
                         if slots_mod.key_slot(name) == slot
                     ]:
                         del self._forwarding[n]
+                        self._forward_retired.pop(n, None)
+                else:
+                    # handoff finalized AWAY: start the forward entries'
+                    # retirement clock (ROADMAP 1(d) — they used to be
+                    # kept forever and grew on churn). Stragglers keep
+                    # forwarding until the TTL; the sweep reaps after.
+                    now = time.monotonic()
+                    for n in self._forwarding:
+                        if slots_mod.key_slot(n) == slot:
+                            self._forward_retired.setdefault(n, now)
+                self._sweep_forwards_locked()
             else:
                 raise protocol.BloomServiceError(
                     "INVALID_ARGUMENT",
@@ -295,6 +326,25 @@ class ClusterState:
     def begin_forwarding(self, name: str, target: str) -> None:
         with self._lock:
             self._forwarding[name] = target
+            # a re-armed migration resets any earlier retirement clock
+            self._forward_retired.pop(name, None)
+
+    def _sweep_forwards_locked(self) -> None:
+        """Reap forward entries whose handoff finalized more than
+        ``forward_ttl_s`` ago (ISSUE 10 satellite): straggler in-flight
+        writes have long since landed or been re-driven, and on slot
+        churn the entries otherwise accumulate forever."""
+        if not self._forward_retired:
+            return
+        cutoff = time.monotonic() - self.forward_ttl_s
+        expired = [
+            n for n, at in self._forward_retired.items() if at <= cutoff
+        ]
+        for n in expired:
+            self._forward_retired.pop(n, None)
+            self._forwarding.pop(n, None)
+        if expired:
+            _counters.incr("cluster_forward_entries_expired", len(expired))
 
     def forward_target(self, name: str) -> Optional[str]:
         """Where a committed write on ``name`` must dual-write to, or
@@ -303,8 +353,10 @@ class ClusterState:
         it no longer forwards — the marks survive the crash, the dict
         does not; such forwards fail ``IMPORT_NOT_READY`` on the target
         until the re-driven migration reseeds the gate, which turns a
-        silent stranded-write into a client-visible retry)."""
+        silent stranded-write into a client-visible retry). Entries of a
+        FINALIZED handoff age out after ``forward_ttl_s``."""
         with self._lock:
+            self._sweep_forwards_locked()
             target = self._forwarding.get(name)
             if target is None:
                 target = self.slots.migrating.get(slots_mod.key_slot(name))

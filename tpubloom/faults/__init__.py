@@ -34,6 +34,10 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
   probe/snapshot-install/tail-record send to the new owner (ISSUE 9)
 * ``cluster.migrate_apply`` — slot migration, target side: in
   ``MigrateInstall`` and per gated dual-write forward received
+* ``ingest.coalesce``     — in ``IngestCoalescer.submit`` before a
+  request parks (nothing applied — retry-safe) (ISSUE 10)
+* ``ingest.flush``        — in the ingest dispatcher before a coalesced
+  flush applies (ditto; every parked request in the flush errors)
 * ``shard.insert`` / ``shard.query`` / ``shard.delete`` — per-shard
   points in :class:`tpubloom.parallel.sharded.ShardedBloomFilter`:
   fired once per shard the batch routes to, with ``shard=<index>``
@@ -103,6 +107,8 @@ KNOWN_POINTS = {
     "ha.vote",
     "cluster.migrate_send",
     "cluster.migrate_apply",
+    "ingest.coalesce",
+    "ingest.flush",
     "shard.insert",
     "shard.query",
     "shard.delete",

@@ -342,6 +342,92 @@ class _FilterBase:
         with obs.phase("h2d"):
             return jnp.asarray(keys_u8), jnp.asarray(lengths)
 
+    def _prep_packed(self, rows: np.ndarray):
+        """Host prep for FIXED-WIDTH pre-packed keys (the ``fixed`` wire
+        encoding, ISSUE 10): ``rows`` is ``uint8[B, W]`` — every key
+        exactly W bytes. Skips the per-key packing loop entirely; pads
+        columns to ``key_len`` and rows to the jit bucket (both
+        vectorized; zero copies when W == key_len and B is already a
+        bucket size)."""
+        with obs.phase("host_prep"):
+            B, W = rows.shape
+            key_len = self.config.key_len
+            if W > key_len:
+                raise ValueError(
+                    f"fixed-width keys are {W} bytes > key_len={key_len}; "
+                    "ship them msgpack-encoded (key_policy applies there)"
+                )
+            if W < key_len:
+                rows = np.pad(rows, ((0, 0), (0, key_len - W)))
+            lengths = np.full((B,), W, dtype=np.int32)
+            Bp = _pad_to_bucket(B)
+            if Bp != B:
+                rows = np.pad(rows, ((0, Bp - B), (0, 0)))
+                lengths = np.pad(lengths, (0, Bp - B), constant_values=-1)
+        return rows, lengths, B
+
+    # staged pipeline API (ISSUE 10): host_prep + H2D split from the
+    # kernel launch, so a batching caller (the server's ingestion
+    # coalescer, bench drivers) can stage batch N+1 while batch N's
+    # kernel is still in flight, then fence N via the returned handle —
+    # double-buffering the host feed against the device.
+
+    def stage_batch(self, keys=None, *, rows=None):
+        """Host prep + H2D only — returns an opaque staged batch for
+        :meth:`launch_insert` / :meth:`launch_query`. Exactly one of
+        ``keys`` (a key sequence) or ``rows`` (fixed-width ``uint8[B,
+        W]``) must be given."""
+        if rows is not None:
+            keys_u8, lengths, B = self._prep_packed(np.asarray(rows, np.uint8))
+        else:
+            keys_u8, lengths, B = self._pack_padded(keys)
+        d_keys, d_lengths = self._stage_batch(keys_u8, lengths)
+        return d_keys, d_lengths, B
+
+    def launch_insert(self, staged):
+        """Launch the insert kernel on a staged batch WITHOUT the
+        completion fence; returns the output array handle the caller
+        fences on (``.block_until_ready()``) before acking the batch."""
+        d_keys, d_lengths, B = staged
+        with obs.phase("kernel"):
+            self.words = self._insert(self.words, d_keys, d_lengths)
+        self.n_inserted += B
+        return self.words
+
+    def launch_query(self, staged):
+        """Launch the membership kernel on a staged batch; returns
+        ``(device hits, valid count)`` — the caller's ``np.asarray`` is
+        the fence + D2H."""
+        d_keys, d_lengths, B = staged
+        with obs.phase("kernel"):
+            hits = self._query(self.words, d_keys, d_lengths)
+        self.n_queried += B
+        return hits, B
+
+    # fixed-width batch API (the `fixed` wire encoding's server path)
+
+    def insert_packed(self, rows: np.ndarray) -> int:
+        """Insert fixed-width pre-packed keys (``uint8[B, W]``, W <=
+        key_len) — the zero-copy decode path of the ``fixed`` wire
+        encoding."""
+        out = self.launch_insert(self.stage_batch(rows=rows))
+        if obs.current() is not None:
+            # same honesty fence as insert_batch: under an active
+            # request the kernel phase must cover real device work
+            with obs.phase("kernel"):
+                out.block_until_ready()
+        return int(rows.shape[0])
+
+    def include_packed(self, rows: np.ndarray) -> np.ndarray:
+        """Membership for fixed-width pre-packed keys."""
+        hits, B = self.launch_query(self.stage_batch(rows=rows))
+        if obs.current() is not None:
+            with obs.phase("kernel"):
+                hits.block_until_ready()
+        with obs.phase("d2h"):
+            out = np.asarray(hits)
+        return out[:B]
+
     def block_until_ready(self) -> None:
         self.words.block_until_ready()
 

@@ -198,6 +198,13 @@ def become_replica(service, primary_address: str, *, epoch=None) -> dict:
             for mf in mfs:
                 with mf.lock:
                     pass
+            if service._coalescer is not None:
+                # ISSUE 10: writes PARKED in the ingestion coalescer
+                # passed the READONLY check but hold no filter lock yet,
+                # so the barrier above does not cover them — drain the
+                # queues so their flushes log in the old seq space
+                # before the applier takes the log over
+                service._coalescer.drain_parked()
         cursor = log_id = None
         if old is not None:
             old.stop()

@@ -229,6 +229,7 @@ def render_service(service, *, exemplars: bool = False) -> str:
         ),
         bounds,
         "Per-RPC phase breakdown (decode/host_prep/h2d/kernel/d2h/encode)",
+        exemplars=exemplars,
     )
     waits = met.get("waits")
     if waits and waits.get("n"):

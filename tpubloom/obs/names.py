@@ -43,6 +43,7 @@ COUNTERS = (
     "cluster_ask_redirects",
     "cluster_filters_migrated",
     "cluster_forward_dups",
+    "cluster_forward_entries_expired",
     "cluster_forward_failures",
     "cluster_forwards",
     "cluster_migrate_installs",
@@ -57,6 +58,10 @@ COUNTERS = (
     "ha_demotions",
     "ha_promotions",
     "ha_role_transitions",
+    "ingest_fallback_direct",
+    "ingest_flushes",
+    "ingest_keys_coalesced",
+    "ingest_requests_coalesced",
     "insert_dedup_hits",
     "keys_deleted",
     "keys_inserted",
@@ -112,6 +117,7 @@ GAUGES = (
     "cluster_slots_owned",
     "ha_epoch",
     "ha_role",
+    "ingest_parked_current",
     "monitor_subscribers",
     "repl_connected_replicas",
     "repl_lag_seconds",
@@ -132,6 +138,9 @@ GAUGES = (
 DYNAMIC_PREFIXES = (
     ("fault_", "counter", "per-point injection counts (tpubloom.faults)"),
     ("stream_", "counter", "per-streaming-RPC open counts (service wrapper)"),
+    ("cluster_slot_keys_total_", "counter",
+     "per-slot key traffic on keyed RPCs (service wrapper, cluster "
+     "mode) — the load signal slot rebalancing should follow"),
 )
 
 COUNTER_SET = frozenset(COUNTERS)

@@ -33,6 +33,10 @@ def summarize_request(method: str, req: dict) -> str:
     for field, value in req.items():
         if field == "keys":
             parts.append(f"keys[{len(value)}]")
+        elif field == "keys_fixed" and isinstance(value, dict):
+            parts.append(
+                f"keys_fixed[{value.get('n')}x{value.get('width')}B]"
+            )
         elif field in ("rid",):
             continue
         elif isinstance(value, (bytes, bytearray)):

@@ -65,6 +65,61 @@ from jax.experimental.pallas import tpu as pltpu
 from tpubloom.ops import blocked
 
 
+class InFlight:
+    """Depth-1 host-side double buffer (ISSUE 10).
+
+    The Pallas grid pipeline double-buffers the HBM stream *inside* one
+    kernel; this is the same idea one level up, for the host feed: a
+    batching driver (the server's ingestion coalescer, bench loops)
+    launches batch N unfenced, parks ``(handle, payload)`` here, stages
+    batch N+1's host_prep/H2D while N's kernel runs, and only then
+    calls :meth:`take` — which fences N and hands back its payload for
+    completion. JAX async dispatch does the actual overlap; this class
+    just keeps the bookkeeping (and the fence) in one place.
+    """
+
+    def __init__(self):
+        self._handle = None
+        self._payload = None
+
+    @property
+    def pending(self) -> bool:
+        return self._payload is not None
+
+    def put(self, handle, payload):
+        """Park one launched batch; returns the PREVIOUS batch's
+        ``(payload, fence_error)`` pair fenced (``(None, None)`` when
+        nothing was in flight) — see :meth:`take`."""
+        prev = self.take()
+        self._handle, self._payload = handle, payload
+        return prev
+
+    def take(self):
+        """Fence and return ``(payload, fence_error)`` — both None when
+        idle. The donated-buffer case is BENIGN and swallowed: with
+        ``donate_argnums`` a later kernel on the same state consumes
+        (deletes) this handle's buffer, and ``block_until_ready`` on a
+        donated buffer raises instead of waiting — but the data
+        dependency already guarantees this kernel completed before its
+        consumer does. Any OTHER fence error (device OOM, a real kernel
+        failure) is RETURNED, not raised or swallowed: the caller must
+        fail the batch's waiters rather than ack work that never
+        happened."""
+        if self._payload is None:
+            return None, None
+        handle, payload = self._handle, self._payload
+        self._handle = self._payload = None
+        err = None
+        if handle is not None and hasattr(handle, "block_until_ready"):
+            try:
+                handle.block_until_ready()
+            except Exception as e:  # noqa: BLE001 — classified below
+                msg = str(e).lower()
+                if "donated" not in msg and "deleted" not in msg:
+                    err = e
+        return payload, err
+
+
 def _u32(x):
     return jnp.asarray(x, jnp.uint32)
 

@@ -256,6 +256,7 @@ class BloomClient:
         read_preference: str = "primary",
         sentinels: Optional[Sequence[str]] = None,
         topology: Optional[dict] = None,
+        encoding: str = "auto",
     ):
         """``replicas`` + ``read_preference="replica"`` route QueryBatch
         traffic round-robin over read replicas (writes always hit
@@ -268,12 +269,30 @@ class BloomClient:
         new primary — rid-dedup server-side guarantees a re-driven
         acknowledged batch never double-applies) or a static
         ``topology={"epoch", "primary", "replicas"}``. Either may stand
-        in for ``address``/``replicas``."""
+        in for ``address``/``replicas``.
+
+        ``encoding`` (ISSUE 10): ``"auto"`` (default) ships
+        fixed-width-encodable key batches — numpy u64 arrays, or lists
+        of equal-length bytes — as the zero-copy ``fixed`` wire
+        encoding once a ``Health`` probe confirmed this connection's
+        server supports it (negotiated per-connection, re-probed after
+        a failover re-point); ``"msgpack"`` pins the classic per-key
+        list; ``"fixed"`` is ``auto`` that raises no error either — it
+        simply falls back when the server or the key shape can't."""
         if read_preference not in ("primary", "replica"):
             raise ValueError(
                 f"read_preference must be 'primary' or 'replica', "
                 f"got {read_preference!r}"
             )
+        if encoding not in ("auto", "fixed", "msgpack"):
+            raise ValueError(
+                f"encoding must be 'auto', 'fixed' or 'msgpack', "
+                f"got {encoding!r}"
+            )
+        self.encoding = encoding
+        #: None = not yet probed for THIS connection; True/False once a
+        #: Health answer settled whether the server speaks `fixed`
+        self._fixed_negotiated: Optional[bool] = None
         self.sentinels = list(sentinels or ())
         #: cached topology epoch — stamped on mutating requests so a
         #: server under a newer topology answers STALE_EPOCH and we
@@ -416,6 +435,8 @@ class BloomClient:
         self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._calls = self._make_calls(self._channel)
         self._stream_calls = self._make_stream_calls(self._channel)
+        # per-CONNECTION capability: the new primary re-negotiates
+        self._fixed_negotiated = None
         if close_old:
             old.close()
         else:
@@ -799,8 +820,45 @@ class BloomClient:
     # -- per-filter ops ------------------------------------------------------
 
     @staticmethod
-    def _keys(keys: Sequence[bytes | str]) -> list:
+    def _keys(keys) -> list:
+        if isinstance(keys, np.ndarray):
+            # integer keys through the msgpack path: each key ships as
+            # its little-endian u64 bytes (the fixed encoding's twin)
+            arr = np.ascontiguousarray(keys, dtype="<u8")
+            return [arr[i].tobytes() for i in range(arr.size)]
         return [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+
+    def _fixed_ok(self) -> bool:
+        """Lazy per-connection negotiation: one Health probe decides
+        whether this server speaks the ``fixed`` encoding. Probe
+        failures degrade to msgpack for this connection — never an
+        error."""
+        if self.encoding == "msgpack":
+            return False
+        if self._fixed_negotiated is None:
+            try:
+                h = self._rpc("Health", {})
+                self._fixed_negotiated = "fixed" in (h.get("encodings") or ())
+            except (grpc.RpcError, protocol.BloomServiceError):
+                self._fixed_negotiated = False
+        return bool(self._fixed_negotiated)
+
+    def _encode_keys(self, req: dict, keys) -> dict:
+        """Fold the key batch into ``req`` under the best negotiated
+        encoding (ISSUE 10): fixed-width-encodable batches (numpy
+        integer arrays — canonically u64 — or equal-length bytes) ship
+        as ONE raw buffer the server decodes zero-copy; everything else
+        takes the msgpack list path."""
+        # negotiation first — it is one cached-bool check after the
+        # initial Health probe, while pack_fixed_keys copies the whole
+        # batch (wasted per call against a msgpack-only server)
+        if self.encoding != "msgpack" and self._fixed_ok():
+            fx = protocol.pack_fixed_keys(keys)
+            if fx is not None:
+                req["keys_fixed"] = fx
+                return req
+        req["keys"] = self._keys(keys)
+        return req
 
     @staticmethod
     def _durability(req: dict, min_replicas, timeout_ms) -> dict:
@@ -816,7 +874,7 @@ class BloomClient:
     def insert_batch(
         self,
         name: str,
-        keys: Sequence[bytes | str],
+        keys,
         *,
         return_presence: bool = False,
         min_replicas: Optional[int] = None,
@@ -828,7 +886,7 @@ class BloomClient:
         bool array when requested. ``min_replicas`` demands a per-call
         durability quorum stronger than the server default."""
         req = self._durability(
-            {"name": name, "keys": self._keys(keys)},
+            self._encode_keys({"name": name}, keys),
             min_replicas, min_replicas_timeout_ms,
         )
         if not return_presence:
@@ -852,8 +910,10 @@ class BloomClient:
             np.frombuffer(resp[field], np.uint8), count=resp["n"]
         ).astype(bool)
 
-    def include_batch(self, name: str, keys: Sequence[bytes | str]) -> np.ndarray:
-        resp = self._rpc("QueryBatch", {"name": name, "keys": self._keys(keys)})
+    def include_batch(self, name: str, keys) -> np.ndarray:
+        resp = self._rpc(
+            "QueryBatch", self._encode_keys({"name": name}, keys)
+        )
         return self._unpack_bool(resp, "hits")
 
     def delete_batch(

@@ -1,0 +1,746 @@
+"""Cross-connection micro-batching ingestion scheduler (ISSUE 10).
+
+The device sweeps tens of millions of keys per second, but the host
+front-end feeds it one gRPC request at a time: per-request decode, lock,
+jit dispatch and — under synchronous replication — one commit barrier
+per write. This module closes that gap with the Redis-pipelining move
+applied server-side: concurrent ``InsertBatch``/``QueryBatch`` RPCs
+**park** in a bounded per-(filter, op) coalescing queue, a single
+dispatcher thread flushes each queue on size/bytes/deadline
+(``--coalesce-max-keys`` / ``--coalesce-max-wait-us``), runs the fused
+kernel ONCE over the merged keys, and demultiplexes per-request results
+(presence slices, ``repl_seq``) back to the parked handler threads.
+
+What one flush amortizes:
+
+* **one device launch** over the merged batch instead of N jit
+  dispatches (and the merged batch hits the kernels' throughput regime
+  instead of their fixed-overhead regime);
+* **one op-log append** — the flush commits as a single merged record,
+  so crash replay and replica streaming see one apply;
+* **one commit barrier** — ``wait_acked`` runs once on the flush's seq
+  at the STRONGEST quorum any parked request demanded; per-request
+  verdicts are then read off the achieved count (a request that asked
+  for less durability than the flush achieved succeeds even when a
+  stricter sibling times out). N quorum writes, one WAIT — exactly the
+  PR-5 pipelining follow-up.
+
+Semantics preserved (regression-tested in ``tests/test_ingest.py``):
+
+* READONLY / STALE_EPOCH / MOVED / ASK / shed all run in the RPC
+  wrapper BEFORE the handler parks anything — coalescing never bypasses
+  an admission or routing decision;
+* per-request **rid-dedup**: replay-unsafe inserts check the dedup
+  cache before parking and every parked request's demuxed response is
+  cached under its own rid (seq-stamped), so client retries replay from
+  cache exactly as on the direct path;
+* **migration windows fall back to the direct path**: a flush checks
+  the dual-write forward target under the filter's op lock (the same
+  lock ``MigrateSlot`` arms forwards under) and, when armed, re-drives
+  each parked request through the ordinary per-request handler + its
+  own barrier + forward — a merged record would make N requests share
+  one ``src_seq`` and the target's exactly-once gate would drop all but
+  the first forward. Requests already carrying ``asking``/``src_seq``
+  (forwards themselves) never park at all.
+
+Double buffering (ISSUE 10, with :class:`tpubloom.ops.sweep.InFlight`):
+an insert flush is launched UNFENCED under the op lock; while its
+kernel runs, the dispatcher stages the next flush's host_prep/H2D, then
+fences the previous flush and completes its waiters — the host feed and
+the device overlap instead of ping-ponging.
+
+Fault points: ``ingest.coalesce`` fires in ``submit`` before a request
+parks (nothing applied — safe to retry); ``ingest.flush`` fires in the
+dispatcher before a flush applies (ditto).
+
+Lock ranks (declared in :mod:`tpubloom.analysis.lock_order`): the queue
+condition is ``ingest.queue`` and is a LEAF apart from gauge updates —
+the dispatcher drops it before touching any filter/registry/log lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from tpubloom import faults
+from tpubloom.obs import counters as obs_counters
+from tpubloom.ops.sweep import InFlight
+from tpubloom.utils import locks
+
+log = logging.getLogger("tpubloom.server")
+
+
+class CoalesceConfig:
+    """Flush policy knobs. A group flushes when its parked keys reach
+    ``max_keys``, its parked payload reaches ``max_bytes``, or its
+    oldest request has waited ``max_wait_us`` — whichever first.
+    ``max_parked_keys`` bounds the queue: submitters block (bounded,
+    natural backpressure — the caller thread was going to wait for its
+    response anyway) until the dispatcher drains."""
+
+    def __init__(
+        self,
+        max_keys: int = 8192,
+        max_wait_us: int = 500,
+        max_bytes: int = 8 * 1024 * 1024,
+        max_parked_keys: Optional[int] = None,
+    ):
+        self.max_keys = int(max_keys)
+        self.max_wait_us = int(max_wait_us)
+        self.max_bytes = int(max_bytes)
+        self.max_parked_keys = int(
+            max_parked_keys if max_parked_keys is not None else 8 * max_keys
+        )
+
+
+class _Entry:
+    __slots__ = (
+        "req", "rid", "nkeys", "nbytes", "rows", "keys",
+        "want_presence", "replay_unsafe", "min_replicas",
+        "timeout_ms", "enq_t", "event", "resp", "error",
+    )
+
+    def __init__(self, req: dict, *, rows, keys, replay_unsafe: bool):
+        self.req = req
+        self.rid = req.get("rid")
+        self.rows = rows          # np.uint8[n, width] (fixed encoding) or None
+        self.keys = keys          # list of key bytes/str, or None
+        self.nkeys = int(rows.shape[0]) if rows is not None else len(keys)
+        self.nbytes = (
+            int(rows.nbytes) if rows is not None
+            else sum(len(k) for k in keys)
+        )
+        self.want_presence = bool(req.get("return_presence"))
+        self.replay_unsafe = replay_unsafe
+        self.min_replicas = int(req.get("min_replicas") or 0)
+        self.timeout_ms = req.get("min_replicas_timeout_ms")
+        self.enq_t = time.monotonic()
+        self.event = threading.Event()
+        self.resp: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+    def complete(self, resp: Optional[dict] = None,
+                 error: Optional[BaseException] = None) -> None:
+        self.resp, self.error = resp, error
+        self.event.set()
+
+
+class IngestCoalescer:
+    """Per-filter request coalescing + the single dispatcher thread."""
+
+    def __init__(self, service, config: Optional[CoalesceConfig] = None):
+        self._service = service
+        self.config = config or CoalesceConfig()
+        #: (filter name, "insert"|"query") -> [entries]
+        self._groups: dict = {}
+        self._parked_keys = 0
+        self._cond = locks.named_condition("ingest.queue")
+        self._stop = False
+        self._flushing = 0
+        self._urgent = 0
+        self._thread: Optional[threading.Thread] = None
+        self._in_dispatch = threading.local()
+        self._inflight = InFlight()
+        #: barrier-bearing finalizes run HERE, not on the dispatcher: a
+        #: quorum wait can block up to its budget, and head-of-line
+        #: blocking every other filter's flushes (including pure reads)
+        #: behind one filter's replication round trip would undo the
+        #: scheduler's point. Barrier-less finalizes (the common async
+        #: case) stay inline — they are just demux.
+        import queue
+
+        self._completions: "queue.Queue" = queue.Queue(maxsize=4)
+        self._completing = 0
+        self._completer: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "IngestCoalescer":
+        self._thread = threading.Thread(
+            target=self._run, name="tpubloom-ingest", daemon=True
+        )
+        self._thread.start()
+        self._completer = threading.Thread(
+            target=self._completion_loop,
+            name="tpubloom-ingest-complete",
+            daemon=True,
+        )
+        self._completer.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and not self._stop
+
+    def in_dispatcher(self) -> bool:
+        """True on the dispatcher thread — the migration-window fallback
+        re-enters the ordinary handlers and must not park again."""
+        return bool(getattr(self._in_dispatch, "active", False))
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Flush everything parked, stop the dispatcher + completer,
+        join both. Parked requests complete normally (drain semantics —
+        their clients were admitted before the drain began)."""
+        thread = self._thread
+        if thread is None:
+            return
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        thread.join(timeout=timeout)
+        self._thread = None
+        completer = self._completer
+        if completer is not None:
+            self._completions.put(None)  # sentinel after the last flush
+            completer.join(timeout=timeout)
+            self._completer = None
+
+    def drain_parked(self, timeout: float = 30.0) -> None:
+        """Block until every currently-parked request has completed —
+        the demotion barrier's coalescer leg (see
+        :func:`tpubloom.ha.promotion.become_replica`: parked writes
+        passed the READONLY fence but hold NO filter lock, so the
+        take-every-lock-once barrier alone would not wait for them).
+        Polls rather than waiting on the condition: the caller holds
+        ``service.promote``, and a condition wait under a foreign lock
+        is exactly what the lock tracker flags."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._urgent += 1
+            self._cond.notify_all()
+        try:
+            while time.monotonic() < deadline:
+                with self._cond:
+                    if (
+                        not self._groups
+                        and not self._flushing
+                        and not self._completing
+                        and not self._inflight.pending
+                    ):
+                        return
+                time.sleep(0.002)
+            log.warning("ingest drain_parked: %.0fs deadline hit", timeout)
+        finally:
+            with self._cond:
+                self._urgent -= 1
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, method: str, req: dict, *,
+               replay_unsafe: bool = False) -> Optional[dict]:
+        """Park one request until its flush completes; returns the
+        demuxed response (or raises its error). Returns **None** when
+        the coalescer is stopped/stopping — the handler falls back to
+        the direct path instead of parking on a dead queue."""
+        from tpubloom.server import protocol
+
+        faults.fire("ingest.coalesce")
+        rows = keys = None
+        fx = protocol.fixed_keys(req)
+        if fx is not None:
+            data, width, n = fx
+            rows = np.frombuffer(data, np.uint8).reshape(n, width)
+        else:
+            keys = req["keys"]
+        kind = "query" if method == "QueryBatch" else "insert"
+        entry = _Entry(req, rows=rows, keys=keys, replay_unsafe=replay_unsafe)
+        name = req["name"]
+        with self._cond:
+            if self._stop:
+                return None
+            # bounded queue: block (briefly, repeatedly) until there is
+            # room — the dispatcher drains continuously, so this is
+            # backpressure, not a deadlock risk (and the timeout keeps
+            # the wait bounded for the runtime lock tracker)
+            while (
+                self._parked_keys + entry.nkeys > self.config.max_parked_keys
+                and self._parked_keys > 0
+                and not self._stop
+            ):
+                self._cond.wait(timeout=0.05)
+            if self._stop:
+                return None
+            self._groups.setdefault((name, kind), []).append(entry)
+            self._parked_keys += entry.nkeys
+            obs_counters.set_gauge("ingest_parked_current", self._parked_keys)
+            self._cond.notify_all()
+        budget = self._entry_budget(entry)
+        if not entry.event.wait(timeout=budget):
+            raise protocol.BloomServiceError(
+                "INTERNAL",
+                f"coalesced {method} did not complete within {budget:.0f}s",
+            )
+        if entry.error is not None:
+            raise entry.error
+        return entry.resp
+
+    def _entry_budget(self, entry: _Entry) -> float:
+        """Generous completion budget: flush deadline + the longest
+        barrier the flush could run + margin. A hang past this is a bug
+        (the dispatcher completes entries even on flush errors)."""
+        barrier_ms = max(
+            int(entry.timeout_ms or 0),
+            self._service.min_replicas_max_lag_ms or 0,
+            1000,
+        )
+        return self.config.max_wait_us / 1e6 + barrier_ms / 1000.0 + 60.0
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _run(self) -> None:
+        self._in_dispatch.active = True
+        stopping = False
+        while not stopping:
+            with self._cond:
+                batch = self._pop_ripe_locked()
+                if batch is None:
+                    if self._stop and not self._groups:
+                        stopping = True
+                    elif not self._inflight.pending:
+                        # nothing ripe and nothing in flight: sleep
+                        # until the oldest entry's deadline or a submit
+                        timeout = self._wait_locked()
+                        self._cond.wait(
+                            timeout=1.0 if timeout is None
+                            else max(timeout, 0.0005)
+                        )
+                        batch = self._pop_ripe_locked()
+                if batch is not None:
+                    self._flushing += 1
+            if batch is None:
+                # the gap gave the in-flight kernel its overlap window —
+                # fence it and complete its waiters (outside all locks)
+                self.flush_inflight()
+                continue
+            (name, kind), entries = batch
+            try:
+                self._flush(name, kind, entries)
+            except BaseException as e:  # noqa: BLE001 — waiters must wake
+                from tpubloom.server import protocol
+
+                log.exception("ingest flush for %r failed", name)
+                err = (
+                    e if isinstance(e, protocol.BloomServiceError)
+                    else protocol.BloomServiceError(
+                        "INTERNAL", f"ingest flush failed: {e!r}"
+                    )
+                )
+                for entry in entries:
+                    if not entry.event.is_set():
+                        entry.complete(error=err)
+            finally:
+                with self._cond:
+                    self._flushing -= 1
+                    self._cond.notify_all()
+        self.flush_inflight()
+
+    def _wait_locked(self) -> Optional[float]:
+        """Seconds until the oldest parked entry ripens (None = idle)."""
+        if not self._groups:
+            return None
+        oldest = min(
+            entries[0].enq_t for entries in self._groups.values() if entries
+        )
+        return max(
+            0.0, oldest + self.config.max_wait_us / 1e6 - time.monotonic()
+        )
+
+    def _pop_ripe_locked(self):
+        """Pop the ripest group (size/bytes/deadline), or None."""
+        now = time.monotonic()
+        ripe_key = None
+        for key, entries in self._groups.items():
+            if not entries:
+                continue
+            nkeys = sum(e.nkeys for e in entries)
+            nbytes = sum(e.nbytes for e in entries)
+            if (
+                self._urgent
+                or self._stop
+                or nkeys >= self.config.max_keys
+                or nbytes >= self.config.max_bytes
+                or now - entries[0].enq_t >= self.config.max_wait_us / 1e6
+            ):
+                ripe_key = key
+                break
+        if ripe_key is None:
+            return None
+        entries = self._groups.pop(ripe_key)
+        self._parked_keys -= sum(e.nkeys for e in entries)
+        obs_counters.set_gauge("ingest_parked_current", self._parked_keys)
+        return ripe_key, entries
+
+    # -- flush ---------------------------------------------------------------
+
+    def _flush(self, name: str, kind: str, entries: list) -> None:
+        from tpubloom.server import protocol
+
+        service = self._service
+        faults.fire("ingest.flush")
+        try:
+            mf = service._get(name)
+        except protocol.BloomServiceError as e:
+            for entry in entries:
+                entry.complete(error=e)
+            return
+        service.metrics.count("ingest_flushes")
+        service.metrics.count("ingest_requests_coalesced", len(entries))
+        total_keys = sum(e.nkeys for e in entries)
+        service.metrics.count("ingest_keys_coalesced", total_keys)
+        if kind == "query":
+            self._flush_query(mf, entries)
+        else:
+            self._flush_insert(name, mf, entries)
+
+    @staticmethod
+    def _demote_wide_rows(mf, rows, keys):
+        """Fixed-width keys WIDER than the filter's key_len cannot take
+        the packed path — materialize the list so ``key_policy``
+        applies (digest/error), exactly as on the direct path's
+        ``_packed_ok`` fallback."""
+        if rows is None:
+            return rows, keys
+        key_len = getattr(getattr(mf.filter, "config", None), "key_len", None)
+        if key_len is not None and rows.shape[1] > key_len:
+            return None, _rows_to_list(rows)
+        return rows, keys
+
+    @staticmethod
+    def _merge(entries: list):
+        """Merged keys for one flush: ``(rows, keys)`` — a single
+        ``uint8[N, W]`` array when every entry shipped fixed-width keys
+        of one width (zero-copy concat), else one materialized list."""
+        widths = {
+            e.rows.shape[1] for e in entries if e.rows is not None
+        }
+        if len(widths) == 1 and all(e.rows is not None for e in entries):
+            if len(entries) == 1:
+                return entries[0].rows, None
+            return np.concatenate([e.rows for e in entries]), None
+        merged: list = []
+        for e in entries:
+            merged.extend(_keys_of(e))
+        return None, merged
+
+    def _flush_query(self, mf, entries: list) -> None:
+        rows, keys = self._demote_wide_rows(mf, *self._merge(entries))
+        # stage OUTSIDE the op lock where the filter supports it — the
+        # host prep/H2D of this flush overlaps the previous flush's
+        # in-flight kernel (double buffering, ISSUE 10)
+        staged = None
+        if self._service._staged_ok(mf):
+            staged = mf.filter.stage_batch(keys, rows=rows)
+        with mf.lock:
+            if staged is not None:
+                hits_dev, _ = mf.filter.launch_query(staged)
+                hits = np.asarray(hits_dev)  # fence + D2H
+            else:
+                hits = np.asarray(
+                    mf.filter.include_batch(
+                        keys if keys is not None else _rows_to_list(rows)
+                    )
+                )
+        self._service.metrics.count("keys_queried", sum(e.nkeys for e in entries))
+        off = 0
+        for entry in entries:
+            span = hits[off: off + entry.nkeys]
+            off += entry.nkeys
+            entry.complete(resp={
+                "ok": True,
+                "hits": np.packbits(span).tobytes(),
+                "n": entry.nkeys,
+                "_coalesced": True,
+            })
+
+    def _flush_insert(self, name: str, mf, entries: list) -> None:
+        service = self._service
+        rows, keys = self._demote_wide_rows(mf, *self._merge(entries))
+        want_presence = any(e.want_presence for e in entries)
+        supports_staged = not want_presence and service._staged_ok(mf)
+        staged = (
+            mf.filter.stage_batch(keys, rows=rows) if supports_staged else None
+        )
+        # fence + settle the PREVIOUS flush before this one's (donating)
+        # launch — its kernel had our whole staging window to run, and a
+        # barrier-bearing completion hops to the completer thread, so
+        # neither blocks the dispatcher.
+        self._settle(*self._inflight.take())
+        presence = None
+        with mf.lock:
+            if service.cluster is not None and (
+                service.cluster.forward_target(name) is not None
+            ):
+                # dual-write window: a merged record would make N
+                # requests share ONE src_seq and the target's gate would
+                # drop every forward but the first — fall back to the
+                # per-request direct path (checked under the SAME lock
+                # MigrateSlot arms forwards under, so a snapshot taken
+                # after this hold covers everything we would apply)
+                fallback = True
+            else:
+                fallback = False
+                if staged is not None:
+                    out = mf.filter.launch_insert(staged)
+                elif want_presence:
+                    klist = keys if keys is not None else _rows_to_list(rows)
+                    if mf.supports_presence:
+                        presence = mf.filter.insert_batch(
+                            klist, return_presence=True
+                        )
+                    else:
+                        presence = mf.filter.include_batch(klist)
+                        mf.filter.insert_batch(klist)
+                    out = None
+                else:
+                    klist = keys if keys is not None else _rows_to_list(rows)
+                    mf.filter.insert_batch(klist)
+                    out = None
+                # ONE op-log append covers the whole flush (log before
+                # notify — the PR-3 ordering rule)
+                logged: dict = {"name": name}
+                if rows is not None:
+                    logged["keys_fixed"] = {
+                        "data": rows.tobytes(),
+                        "width": int(rows.shape[1]),
+                        "n": int(rows.shape[0]),
+                    }
+                else:
+                    logged["keys"] = keys
+                seq = service._log_op("InsertBatch", logged, mf)
+                if mf.checkpointer:
+                    mf.checkpointer.notify_inserts(
+                        sum(e.nkeys for e in entries)
+                    )
+        if fallback:
+            self._fallback_direct(entries)
+            return
+        service.metrics.count(
+            "keys_inserted", sum(e.nkeys for e in entries)
+        )
+        if presence is not None:
+            presence = np.asarray(presence)  # fence + D2H, outside the lock
+
+        def finalize():
+            self._finalize_insert(entries, seq, presence)
+
+        payload = (entries, finalize, self._needs_barrier(entries, seq))
+        if out is not None:
+            # double buffering: park the launched (unfenced) kernel;
+            # the NEXT flush's staging (or the run loop's idle check)
+            # overlaps it, then settles us
+            self._inflight.put(out, payload)
+        else:
+            self._settle(payload, None)
+
+    def _needs_barrier(self, entries, seq) -> bool:
+        if seq is None:
+            return False
+        return max(
+            [self._service.min_replicas_to_write]
+            + [e.min_replicas for e in entries]
+        ) > 0
+
+    def _settle(self, payload, fence_err) -> None:
+        """Complete one fenced flush. A REAL fence error (device/kernel
+        failure — the benign donated-buffer case is filtered by
+        :meth:`InFlight.take`) fails every waiter instead of acking
+        writes that never landed. Otherwise the finalize runs inline
+        when it is pure demux, and hops to the completer thread when it
+        carries a commit barrier — a quorum wait must never head-of-
+        line-block other filters' flushes on the dispatcher."""
+        if payload is None:
+            return
+        entries, finalize, barrier = payload
+        if fence_err is not None:
+            from tpubloom.server import protocol
+
+            log.error("ingest flush kernel failed: %r", fence_err)
+            err = protocol.BloomServiceError(
+                "INTERNAL", f"coalesced flush kernel failed: {fence_err!r}"
+            )
+            for entry in entries:
+                if not entry.event.is_set():
+                    entry.complete(error=err)
+            return
+        if barrier:
+            with self._cond:
+                self._completing += 1
+            self._completions.put(finalize)  # bounded — backpressure
+        else:
+            finalize()
+
+    def _completion_loop(self) -> None:
+        while True:
+            fn = self._completions.get()
+            if fn is None:
+                return
+            try:
+                fn()  # _finalize_insert is self-protective
+            finally:
+                with self._cond:
+                    self._completing -= 1
+                    self._cond.notify_all()
+
+    def flush_inflight(self) -> None:
+        """Fence + settle any parked double-buffered flush (dispatcher
+        thread only — the run loop calls this when the queues go idle)."""
+        payload, err = self._inflight.take()
+        if payload is None:
+            return
+        self._settle(payload, err)
+        with self._cond:
+            self._cond.notify_all()
+
+    def _finalize_insert(self, entries, seq, presence) -> None:
+        """Demux one applied flush back to its parked requests: dedup
+        caching, presence slices, and ONE commit barrier whose achieved
+        count settles every request's own quorum. Self-protective: any
+        unexpected error completes EVERY still-parked entry (a finalize
+        may run from the double-buffer path, outside the run loop's
+        per-flush catch — waiters must never hang)."""
+        from tpubloom.server import protocol
+
+        try:
+            self._finalize_insert_inner(entries, seq, presence)
+        except BaseException as e:  # noqa: BLE001 — waiters must wake
+            log.exception("ingest finalize failed")
+            err = (
+                e if isinstance(e, protocol.BloomServiceError)
+                else protocol.BloomServiceError(
+                    "INTERNAL", f"ingest finalize failed: {e!r}"
+                )
+            )
+            for entry in entries:
+                if not entry.event.is_set():
+                    entry.complete(error=err)
+
+    def _finalize_insert_inner(self, entries, seq, presence) -> None:
+        from tpubloom.server import protocol
+
+        service = self._service
+        acked, barrier_error = self._flush_barrier(entries, seq)
+        off = 0
+        for entry in entries:
+            resp: dict = {"ok": True, "n": entry.nkeys}
+            if seq is not None:
+                resp["repl_seq"] = seq
+            if entry.want_presence and presence is not None:
+                span = presence[off: off + entry.nkeys]
+                resp["presence"] = np.packbits(span).tobytes()
+            off += entry.nkeys
+            if entry.replay_unsafe:
+                # cache the CLEAN response (no barrier verdict): a
+                # same-rid retry replays it through the wrapper, which
+                # re-waits on the same record — direct-path parity
+                service._dedup_put(entry.rid, dict(resp))
+            needed = max(service.min_replicas_to_write, entry.min_replicas)
+            if needed > 0:
+                if seq is None and service.oplog is None:
+                    entry.complete(error=protocol.BloomServiceError(
+                        "NOT_ENOUGH_REPLICAS",
+                        f"min_replicas={needed} requires replication "
+                        f"(start the server with --repl-log-dir)",
+                        details={"acked": 0, "needed": needed,
+                                 "applied": True},
+                    ))
+                    continue
+                if seq is not None and acked < needed:
+                    details = {
+                        "acked": acked, "needed": needed, "seq": seq,
+                        "applied": True, "coalesced": len(entries),
+                    }
+                    if barrier_error is not None:
+                        details.setdefault(
+                            "timeout_ms",
+                            barrier_error.details.get("timeout_ms"),
+                        )
+                    entry.complete(error=protocol.BloomServiceError(
+                        "NOT_ENOUGH_REPLICAS",
+                        f"only {acked}/{needed} replica(s) acked seq "
+                        f"{seq} for this coalesced flush — the write "
+                        f"applied, only its quorum ack is missing",
+                        details=details,
+                    ))
+                    continue
+                resp["acked_replicas"] = acked
+            resp["_coalesced"] = True
+            entry.complete(resp=resp)
+
+    def _flush_barrier(self, entries, seq):
+        """ONE ``wait_acked`` for the whole flush, at the strongest
+        quorum any entry demanded and the longest budget any entry
+        brought; returns ``(achieved ack count, barrier error or
+        None)``."""
+        from tpubloom.server import protocol
+
+        service = self._service
+        needed = max(
+            [service.min_replicas_to_write]
+            + [e.min_replicas for e in entries]
+        )
+        if needed <= 0 or seq is None:
+            return 0, None
+        budgets = [int(e.timeout_ms) for e in entries
+                   if e.timeout_ms is not None]
+        barrier_req: dict = {"min_replicas": needed}
+        if budgets:
+            barrier_req["min_replicas_timeout_ms"] = max(budgets)
+        try:
+            resp = service.commit_barrier(barrier_req, {"repl_seq": seq})
+            return int(resp.get("acked_replicas") or 0), None
+        except protocol.BloomServiceError as e:
+            if e.code != "NOT_ENOUGH_REPLICAS":
+                raise
+            acked = int(e.details.get("acked") or 0)
+            # the fail-fast (fewer connected than the max quorum) path
+            # reports 0 — weaker per-entry quorums may still be met
+            max_age = (service.min_replicas_max_lag_ms or 0) / 1000.0
+            acked = max(
+                acked,
+                service.repl_sessions.count_acked(seq, max_age=max_age),
+            )
+            return acked, e
+
+    def _fallback_direct(self, entries: list) -> None:
+        """Migration-window fallback: re-drive each parked request
+        through the ordinary handler + its OWN barrier and dual-write
+        forward — per-request seqs keep the target's exactly-once gate
+        sound. Rare (only while a slot is mid-handoff), so the lost
+        amortization is acceptable."""
+        from tpubloom.cluster import migrate as cluster_migrate
+        from tpubloom.server import protocol
+
+        service = self._service
+        service.metrics.count("ingest_fallback_direct", len(entries))
+        for entry in entries:
+            try:
+                resp = service.InsertBatch(entry.req)
+                if resp.get("ok"):
+                    resp = service.commit_barrier(entry.req, resp)
+                    resp = cluster_migrate.forward_op(
+                        service, "InsertBatch", entry.req, resp
+                    )
+                resp = dict(resp)
+                resp["_coalesced"] = True
+                entry.complete(resp=resp)
+            except protocol.BloomServiceError as e:
+                entry.complete(error=e)
+            except BaseException as e:  # noqa: BLE001 — waiter must wake
+                entry.complete(error=protocol.BloomServiceError(
+                    "INTERNAL", f"ingest fallback failed: {e!r}"
+                ))
+
+
+def _keys_of(entry: _Entry) -> list:
+    if entry.keys is not None:
+        return list(entry.keys)
+    return _rows_to_list(entry.rows)
+
+
+def _rows_to_list(rows: np.ndarray) -> list:
+    return [rows[i].tobytes() for i in range(rows.shape[0])]

@@ -121,12 +121,16 @@ class Metrics:
         rid: Optional[str] = None,
     ) -> None:
         """File one finished RPC: total latency + its phase breakdown.
-        ``rid`` becomes the latency bucket's exemplar — the slowlog /
-        trace correlation handle (ISSUE 9 satellite)."""
+        ``rid`` becomes the latency AND phase buckets' exemplar — the
+        slowlog / trace correlation handle (ISSUE 9 satellite; phases
+        joined in ISSUE 10: a decode or h2d outlier now names the exact
+        request behind it, same as the end-to-end histogram)."""
         with self._lock:
             self.latency[method].observe(seconds, rid=rid)
             for phase_name, phase_s in (phases or {}).items():
-                self.phases[f"{method}/{phase_name}"].observe(phase_s)
+                self.phases[f"{method}/{phase_name}"].observe(
+                    phase_s, rid=rid
+                )
 
     def observe_wait(self, seconds: float) -> None:
         """File one replica-ack wait (commit barrier or Wait RPC)."""

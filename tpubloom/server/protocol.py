@@ -18,6 +18,21 @@ request id). The server folds it into profiler spans and slowlog entries;
 the stock Python client stamps one on every call. Servers generate one
 when absent, so old clients stay compatible.
 
+Fixed-width key encoding (ISSUE 10): per-key msgpack ``bin`` framing is
+the host-side decode hot spot once the device stops being the bottleneck
+(the PR-1 phase histograms put decode+host_prep ahead of the kernel on
+the server path). A request MAY therefore replace its ``keys`` list with
+``keys_fixed = {"data": <raw bytes>, "width": W, "n": N}`` — N keys of
+exactly W bytes each, concatenated. The canonical use is u64 keys
+(W=8, little-endian), which the server decodes **zero-copy** via
+``np.frombuffer(data).reshape(n, width)`` straight into the shape the
+hash kernels consume — no per-key Python loop at all. Capability
+discovery: ``Health`` answers ``encodings: ["msgpack", "fixed"]``;
+clients negotiate per-connection and keep the msgpack list path for
+servers (or key sets) that can't. The two encodings are semantically
+identical: a u64 shipped fixed hits the same filter positions as its
+8-byte little-endian ``bin`` twin.
+
 Service: ``/tpubloom.BloomService/<Method>`` for Method in METHODS.
 """
 
@@ -149,6 +164,106 @@ SENTINEL_METHODS = ("Ping", "Topology", "VoteDown", "AnnounceTopology")
 #: topology-aware clients re-point on failover without waiting for a
 #: refresh-on-error round trip.
 SENTINEL_STREAM_METHODS = ("TopologyEvents",)
+
+
+#: Wire encodings this server generation understands for bulk key
+#: payloads (advertised by ``Health`` for per-connection negotiation).
+#: ``msgpack`` = the original per-key ``bin`` list; ``fixed`` = the
+#: ``keys_fixed`` raw-buffer form above.
+ENCODINGS = ("msgpack", "fixed")
+
+#: Sanity bound on ``keys_fixed.width`` — wider "keys" are almost
+#: certainly a corrupt length field, and width*n must not be trusted to
+#: allocate unbounded memory shapes.
+FIXED_WIDTH_MAX = 4096
+
+
+def fixed_keys(req: dict):
+    """Validate and unpack a request's ``keys_fixed`` payload; returns
+    ``(data, width, n)`` or None when the request uses the msgpack
+    ``keys`` list. Raises :class:`BloomServiceError`
+    ``INVALID_ARGUMENT`` on a malformed frame (mismatched byte count,
+    non-positive width) — decode errors must be structured, not
+    a reshape traceback."""
+    fx = req.get("keys_fixed")
+    if fx is None:
+        return None
+    try:
+        data, width, n = fx["data"], int(fx["width"]), int(fx["n"])
+    except (TypeError, KeyError, ValueError):
+        raise BloomServiceError(
+            "INVALID_ARGUMENT",
+            "keys_fixed must be {data: bytes, width: int, n: int}",
+        )
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise BloomServiceError(
+            "INVALID_ARGUMENT", "keys_fixed.data must be raw bytes"
+        )
+    if width <= 0 or width > FIXED_WIDTH_MAX or n < 0:
+        raise BloomServiceError(
+            "INVALID_ARGUMENT",
+            f"keys_fixed width {width} / n {n} out of range "
+            f"(0 < width <= {FIXED_WIDTH_MAX}, n >= 0)",
+        )
+    if len(data) != width * n:
+        raise BloomServiceError(
+            "INVALID_ARGUMENT",
+            f"keys_fixed carries {len(data)} bytes, expected "
+            f"width*n = {width * n}",
+        )
+    return bytes(data), width, n
+
+
+#: Minimum batch size before an equal-width bytes LIST auto-upgrades to
+#: the fixed encoding: tiny batches gain nothing from it, and the
+#: upgrade changes the op-log record shape record consumers see — keep
+#: scalar/small calls byte-identical to the classic path. Numpy arrays
+#: always ship fixed (passing one IS the opt-in).
+FIXED_LIST_MIN = 8
+
+
+def pack_fixed_keys(keys) -> dict | None:
+    """Client-side: the ``keys_fixed`` payload for a batch, or None when
+    the batch is not fixed-width encodable. Accepts a numpy integer
+    array (canonically u64 — shipped as little-endian bytes) or a
+    list/tuple of at least :data:`FIXED_LIST_MIN` equal-length
+    ``bytes``."""
+    import numpy as np
+
+    if isinstance(keys, np.ndarray) and keys.ndim == 1 and keys.size:
+        if keys.dtype.kind not in ("u", "i"):
+            return None
+        arr = np.ascontiguousarray(keys, dtype="<u8")
+        return {"data": arr.tobytes(), "width": 8, "n": int(arr.size)}
+    if isinstance(keys, (list, tuple)) and len(keys) >= FIXED_LIST_MIN:
+        first = keys[0]
+        if not isinstance(first, (bytes, bytearray)):
+            return None
+        width = len(first)
+        if width == 0 or width > FIXED_WIDTH_MAX:
+            return None
+        if any(
+            not isinstance(k, (bytes, bytearray)) or len(k) != width
+            for k in keys
+        ):
+            return None
+        return {"data": b"".join(bytes(k) for k in keys),
+                "width": width, "n": len(keys)}
+    return None
+
+
+def batch_size(req: dict) -> int:
+    """Key count of a request under either encoding (0 when keyless)."""
+    keys = req.get("keys")
+    if isinstance(keys, list):
+        return len(keys)
+    fx = req.get("keys_fixed")
+    if isinstance(fx, dict):
+        try:
+            return int(fx["n"])
+        except (KeyError, TypeError, ValueError):
+            return 0
+    return 0
 
 
 def sentinel_method_path(method: str) -> str:

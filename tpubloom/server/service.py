@@ -247,6 +247,7 @@ class BloomService:
         min_replicas_to_write: int = 0,
         min_replicas_max_lag_ms: int = DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
         cluster=None,
+        coalesce=None,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -367,6 +368,16 @@ class BloomService:
         #: further writes are fail-stopped (Redis aborts writes on AOF
         #: write errors the same way) until an operator restarts
         self.oplog_error: Optional[str] = None
+        #: ingestion coalescer (ISSUE 10): with a
+        #: :class:`tpubloom.server.ingest.CoalesceConfig` attached,
+        #: concurrent InsertBatch/QueryBatch RPCs park in per-filter
+        #: queues and flush as ONE device launch + ONE op-log append +
+        #: ONE commit barrier. None = the pre-ISSUE-10 direct path.
+        self._coalescer = None
+        if coalesce is not None:
+            from tpubloom.server.ingest import IngestCoalescer
+
+            self._coalescer = IngestCoalescer(self, coalesce).start()
 
     @property
     def draining(self) -> bool:
@@ -1066,6 +1077,9 @@ class BloomService:
             "max_in_flight": self.max_in_flight,
             "role": "replica" if self.read_only else "primary",
             "epoch": self.epoch,
+            # wire-encoding capability advertisement (ISSUE 10): clients
+            # negotiate the zero-copy `fixed` key encoding off this
+            "encodings": list(protocol.ENCODINGS),
         }
         if self.listen_address:
             resp["listen"] = self.listen_address
@@ -1474,6 +1488,82 @@ class BloomService:
         with self._lock:
             return {"ok": True, "filters": sorted(self._filters)}
 
+    # -- keyed-batch helpers: fixed wire encoding + coalescing (ISSUE 10) ----
+
+    @staticmethod
+    def _fixed_rows(req: dict):
+        """``uint8[n, width]`` view of a request's ``keys_fixed`` buffer
+        (zero-copy — ``np.frombuffer`` over the decoded msgpack bin), or
+        None for msgpack-list requests."""
+        fx = protocol.fixed_keys(req)
+        if fx is None:
+            return None
+        data, width, n = fx
+        return np.frombuffer(data, np.uint8).reshape(n, width)
+
+    @classmethod
+    def _keys_list(cls, req: dict) -> list:
+        """Materialized key list under either encoding — the fallback
+        for paths that need per-key bytes (presence, key_policy,
+        filters without a packed API)."""
+        keys = req.get("keys")
+        if keys is not None:
+            return keys
+        rows = cls._fixed_rows(req)
+        if rows is None:
+            return []
+        return [rows[i].tobytes() for i in range(rows.shape[0])]
+
+    @staticmethod
+    def _op_keys(req: dict) -> dict:
+        """The key payload for this request's op-log record, in its
+        original encoding (replay + replica apply handle both)."""
+        if "keys" in req:
+            return {"keys": req["keys"]}
+        return {"keys_fixed": req["keys_fixed"]}
+
+    @staticmethod
+    def _staged_ok(mf: _Managed) -> bool:
+        """Whether the filter may take the staged/packed fast paths.
+        Sharded filters are excluded: their ``insert_batch``/
+        ``include_batch`` overrides fire the per-shard fault points
+        (``shard.*``), which the raw kernel launch would bypass."""
+        return (
+            hasattr(mf.filter, "stage_batch")
+            and getattr(mf.filter.config, "shards", 1) <= 1
+        )
+
+    @classmethod
+    def _packed_ok(cls, mf: _Managed, rows) -> bool:
+        """Whether the fixed-width rows can take the filter's zero-copy
+        packed path (keys wider than key_len fall back to the list path
+        so ``key_policy`` applies there)."""
+        return (
+            rows is not None
+            and cls._staged_ok(mf)
+            and hasattr(mf.filter, "insert_packed")
+            and rows.shape[1] <= getattr(mf.filter.config, "key_len", 0)
+        )
+
+    def _coalesce_eligible(self, req: dict) -> bool:
+        """Whether this request may park in the ingestion coalescer.
+        Excluded: replay/stream-apply (exactly-once is seq-gated per
+        RECORD there), the dispatcher's own fallback re-drives, and
+        migration forwards (``asking``/``src_seq`` must hit the import
+        gate per-request)."""
+        c = self._coalescer
+        if c is None or not c.running or c.in_dispatcher():
+            return False
+        if self._replaying or getattr(self._apply_seq_hint, "seq", None) is not None:
+            return False
+        if req.get("asking") or req.get("src_seq") is not None:
+            return False
+        if not isinstance(req.get("keys"), list) and not isinstance(
+            req.get("keys_fixed"), dict
+        ):
+            return False
+        return True
+
     @staticmethod
     def _insert_replay_unsafe(mf: _Managed, want_presence: bool) -> bool:
         """True when a REPLAYED insert that already landed would corrupt
@@ -1498,37 +1588,51 @@ class BloomService:
             if cached is not None:
                 self.metrics.count("insert_dedup_hits")
                 return cached
+        if self._coalesce_eligible(req):
+            resp = self._coalescer.submit(
+                "InsertBatch", req, replay_unsafe=replay_unsafe
+            )
+            if resp is not None:
+                return resp
+            # coalescer stopped between the check and the park — direct
+        nkeys = protocol.batch_size(req)
+        rows = self._fixed_rows(req)
         with mf.lock, tracing.request_span(
-            "InsertBatch", batch=len(req["keys"]), rid=obs.current_rid()
+            "InsertBatch", batch=nkeys, rid=obs.current_rid()
         ):
             presence = None
             if want_presence:
+                keys = self._keys_list(req)
                 # fused test-and-insert (blocked filters run it as one
                 # device pass; others fall back to query-then-insert)
                 if mf.supports_presence:
                     presence = mf.filter.insert_batch(
-                        req["keys"], return_presence=True
+                        keys, return_presence=True
                     )
                 else:
-                    presence = mf.filter.include_batch(req["keys"])
-                    mf.filter.insert_batch(req["keys"])
+                    presence = mf.filter.include_batch(keys)
+                    mf.filter.insert_batch(keys)
+            elif self._packed_ok(mf, rows):
+                # fixed wire encoding: the raw buffer reshapes straight
+                # into the hash kernels' [B, L] layout — no per-key loop
+                mf.filter.insert_packed(rows)
             else:
-                mf.filter.insert_batch(req["keys"])
+                mf.filter.insert_batch(self._keys_list(req))
             # log BEFORE notify_inserts: notify may trigger a checkpoint
             # whose snapshot contains this batch — its repl_seq stamp
             # (sampled from applied_seq at trigger time) must therefore
             # already include this op, or a crash-replay re-applies it
             seq = self._log_op(
-                "InsertBatch", {"name": req["name"], "keys": req["keys"]}, mf
+                "InsertBatch", {"name": req["name"], **self._op_keys(req)}, mf
             )
             if seq is None:
                 # apply path (replay / stream apply): echo the record's
                 # own seq so the dedup-cached response stays seq-stamped
                 seq = getattr(self._apply_seq_hint, "seq", None)
             if mf.checkpointer:
-                mf.checkpointer.notify_inserts(len(req["keys"]))
-        self.metrics.count("keys_inserted", len(req["keys"]))
-        resp = {"ok": True, "n": len(req["keys"])}
+                mf.checkpointer.notify_inserts(nkeys)
+        self.metrics.count("keys_inserted", nkeys)
+        resp = {"ok": True, "n": nkeys}
         if seq is not None:
             resp["repl_seq"] = seq
         if presence is not None:
@@ -1539,15 +1643,26 @@ class BloomService:
 
     def QueryBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
+        if self._coalesce_eligible(req):
+            resp = self._coalescer.submit("QueryBatch", req)
+            if resp is not None:
+                return resp
+        nkeys = protocol.batch_size(req)
+        rows = self._fixed_rows(req)
         with mf.lock, tracing.request_span(
-            "QueryBatch", batch=len(req["keys"]), rid=obs.current_rid()
+            "QueryBatch", batch=nkeys, rid=obs.current_rid()
         ):
             # see class docstring: donation makes the lock mandatory
-            hits = mf.filter.include_batch(req["keys"])
-        self.metrics.count("keys_queried", len(req["keys"]))
+            if rows is not None and self._packed_ok(mf, rows) and hasattr(
+                mf.filter, "include_packed"
+            ):
+                hits = mf.filter.include_packed(rows)
+            else:
+                hits = mf.filter.include_batch(self._keys_list(req))
+        self.metrics.count("keys_queried", nkeys)
         with obs.phase("encode"):
             packed = np.packbits(hits).tobytes()
-        return {"ok": True, "hits": packed, "n": len(req["keys"])}
+        return {"ok": True, "hits": packed, "n": nkeys}
 
     def _dedup_get(self, rid) -> Optional[dict]:
         if not rid or not self._dedup_capacity:
@@ -1590,15 +1705,16 @@ class BloomService:
         if cached is not None:
             self.metrics.count("delete_dedup_hits")
             return cached
+        nkeys = protocol.batch_size(req)
         with mf.lock:
-            mf.filter.delete_batch(req["keys"])
+            mf.filter.delete_batch(self._keys_list(req))
             seq = self._log_op(
-                "DeleteBatch", {"name": req["name"], "keys": req["keys"]}, mf
+                "DeleteBatch", {"name": req["name"], **self._op_keys(req)}, mf
             )
         if seq is None:  # apply path: keep the dedup response seq-stamped
             seq = getattr(self._apply_seq_hint, "seq", None)
-        self.metrics.count("keys_deleted", len(req["keys"]))
-        resp = {"ok": True, "n": len(req["keys"])}
+        self.metrics.count("keys_deleted", nkeys)
+        resp = {"ok": True, "n": nkeys}
         if seq is not None:
             resp["repl_seq"] = seq
         self._dedup_put(rid, resp)
@@ -1701,6 +1817,10 @@ class BloomService:
         graceful drain should ``begin_drain()`` + stop the gRPC server
         first so no insert races the final snapshots."""
         self.begin_drain()
+        if self._coalescer is not None:
+            # flush + complete every parked request BEFORE the final
+            # snapshots (their writers were admitted pre-drain)
+            self._coalescer.close()
         with self._lock:
             filters = list(self._filters.items())
         for name, mf in filters:
@@ -1763,8 +1883,7 @@ def _wrap(service: BloomService, method_name: str):
                     # context pre-generated a server-side id otherwise
                     if isinstance(req.get("rid"), str) and req["rid"]:
                         rctx.rid = req["rid"]
-                    keys = req.get("keys")
-                    rctx.batch = len(keys) if isinstance(keys, list) else 0
+                    rctx.batch = protocol.batch_size(req)
                     rctx.summary = summarize_request(method_name, req)
                     name = req.get("name")
                     req_name = name if isinstance(name, str) else None
@@ -1808,6 +1927,17 @@ def _wrap(service: BloomService, method_name: str):
                                 else None
                             ),
                         )
+                        if rctx.batch:
+                            # per-slot key-traffic counters (ISSUE 10
+                            # satellite, ROADMAP item 6): rebalance
+                            # decisions can be load-driven instead of
+                            # slot-count-driven. Dynamic series —
+                            # declared via DYNAMIC_PREFIXES in obs.names
+                            obs_counters.incr(
+                                "cluster_slot_keys_total_"
+                                f"{cluster_slots.key_slot(req_name)}",
+                                rctx.batch,
+                            )
                         if (
                             method_name in protocol.MUTATING_METHODS
                             and req.get("asking")
@@ -1858,7 +1988,7 @@ def _wrap(service: BloomService, method_name: str):
                         resp = cached if cached is not None else {
                             "ok": True,
                             "migrate_dup": True,
-                            "n": len(req.get("keys") or ()),
+                            "n": protocol.batch_size(req),
                         }
                     else:
                         try:
@@ -1871,6 +2001,15 @@ def _wrap(service: BloomService, method_name: str):
                                     req_name, src_seq
                                 )
                             raise
+                    # a coalesced response already paid its flush's
+                    # shared barrier (ISSUE 10) and was proven outside
+                    # any dual-write window under the op lock — pop the
+                    # marker and skip both. The dedup-cached copy is
+                    # stored WITHOUT the marker, so a same-rid retry
+                    # re-waits through the normal barrier below.
+                    coalesced_done = isinstance(resp, dict) and bool(
+                        resp.pop("_coalesced", False)
+                    )
                     # durability gate (ISSUE 5): block OUTSIDE every
                     # lock until the quorum acked this write's record;
                     # a dedup-cache replay re-enters here with the
@@ -1879,6 +2018,7 @@ def _wrap(service: BloomService, method_name: str):
                     # stands, only its quorum ack is missing)
                     if (
                         not gate_dup
+                        and not coalesced_done
                         and method_name in protocol.MUTATING_METHODS
                         and resp.get("ok")
                     ):
@@ -2212,6 +2352,25 @@ def main(argv: Optional[list] = None) -> None:
         "tpubloom.cluster init`",
     )
     parser.add_argument(
+        "--coalesce-max-keys",
+        type=int,
+        default=0,
+        metavar="N",
+        help="enable the cross-connection ingestion coalescer (ISSUE "
+        "10): concurrent InsertBatch/QueryBatch RPCs park in per-filter "
+        "queues and flush as ONE device launch + ONE op-log append + "
+        "ONE commit barrier once N keys are parked (or the wait budget "
+        "expires). 0 disables (the default, per-request path)",
+    )
+    parser.add_argument(
+        "--coalesce-max-wait-us",
+        type=int,
+        default=500,
+        metavar="U",
+        help="coalescer flush deadline: a parked request never waits "
+        "longer than this for batch-mates (default 500us)",
+    )
+    parser.add_argument(
         "--min-replicas-max-lag-ms",
         type=int,
         default=DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
@@ -2248,6 +2407,18 @@ def main(argv: Optional[list] = None) -> None:
             "cluster mode: %s (map epoch %d)",
             announce, cluster_state.epoch(),
         )
+    coalesce = None
+    if args.coalesce_max_keys > 0:
+        from tpubloom.server.ingest import CoalesceConfig
+
+        coalesce = CoalesceConfig(
+            max_keys=args.coalesce_max_keys,
+            max_wait_us=args.coalesce_max_wait_us,
+        )
+        log.info(
+            "ingestion coalescer: flush at %d keys / %dus",
+            args.coalesce_max_keys, args.coalesce_max_wait_us,
+        )
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
@@ -2259,6 +2430,7 @@ def main(argv: Optional[list] = None) -> None:
         min_replicas_to_write=args.min_replicas_to_write,
         min_replicas_max_lag_ms=args.min_replicas_max_lag_ms,
         cluster=cluster_state,
+        coalesce=coalesce,
     )
     if oplog is not None:
         stats = service.replay_oplog()

@@ -82,17 +82,29 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(tmpdir: str, idx: int, extra_args: list) -> tuple:
+def _spawn(
+    tmpdir: str, idx: int, extra_args: list, *,
+    child_src: str = None, env_extra: dict = None, env_drop: tuple = (),
+) -> tuple:
+    """Boot one subprocess server. ``child_src`` overrides the CPU-pinned
+    default script; ``env_extra``/``env_drop`` adjust the child env
+    (multichip_load uses them for the forced device mesh, the native-
+    backend mode, and to strip debug instrumentation its perf gates
+    must not measure)."""
     port = _free_port()
     script = os.path.join(tmpdir, f"child-{idx}.py")
     with open(script, "w") as f:
-        f.write(_CHILD)
+        f.write(child_src or _CHILD)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
     }
+    for k in env_drop:
+        env.pop(k, None)
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.Popen(
         [sys.executable, script, str(port), *extra_args],
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env,

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Multichip data-parallel serving load generator (ISSUE 11).
+
+Makes aggregate **keys/sec/POD** the headline number: a real subprocess
+server whose JAX backend exposes an N-device mesh (a real TPU slice, or
+the ``--xla_force_host_platform_device_count=8`` CPU mesh this CI image
+uses), one ``ShardedBloomFilter`` spanning every device, and the PR-10
+ingestion coalescer feeding it — the 4.2× connection-scaling multiplied
+by N-device data parallelism instead of fenced off from it (the PR-10
+exclusion this PR lifts).
+
+What the numbers mean:
+
+* ``keys_per_sec_pod`` — the headline: aggregate end-to-end insert rate
+  over ``CONNECTIONS`` concurrent connections into ONE mesh-sharded
+  filter through the coalescer (gRPC + decode + coalesce + ONE
+  ``shard_map`` launch per flush);
+* ``single_conn_keys_per_sec`` — one connection's ping-pong rate
+  against the same server (every request pays the full per-request cost
+  serially, plus the coalesce window);
+* ``per_request_keys_per_sec`` — the SAME aggregate load against a
+  second server WITHOUT the coalescer: every RPC runs its own
+  ``shard_map`` launch under the filter's op lock. This is what
+  "sharded filters are excluded from the staged/packed paths" used to
+  cost;
+* ``scaling_vs_single`` — aggregate / single. GATE ``>= 2.0``;
+* ``scaling_vs_per_request`` — aggregate / per-request. GATE ``>= 1.0``
+  (coalesced sharded ingest must not lose to the per-request path);
+* ``requests_per_flush`` — anti-gaming assert (``> 1.5``): the gates
+  must not pass without actual coalescing.
+
+All gates re-measure ONCE with a doubled window before failing (the
+cluster_smoke / ingest_load discipline — a scheduler hiccup inside a
+2 s window on a small shared runner must not read as a code defect).
+
+Servers run on a forced 8-device CPU mesh by default so the bench runs
+anywhere; ``--native-backend`` drops the forcing for a real TPU slice.
+When the child backend still exposes fewer than 2 devices the run
+reports ``{"skipped": ...}`` instead of failing (skip-clean, like
+cluster_smoke on backends without what it needs).
+
+Run directly (prints one JSON line) or via tier-1
+(``tests/test_multichip.py::test_multichip_load_smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (script runs)
+
+import ingest_load  # noqa: E402 — shared _hammer/_free_port/BATCH helpers
+
+#: devices the forced CPU mesh exposes (and the shard count of the
+#: served filter — one shard row per device).
+DEVICES = 8
+CONNECTIONS = 8
+GATE_MULTI = 2.0  # aggregate vs one connection
+GATE_VS_PER_REQUEST = 1.0  # coalesced vs the per-request sharded path
+
+#: native-backend child: NO platform pin (ingest_load._CHILD hard-pins
+#: cpu, which would turn a --native-backend run on a real TPU slice
+#: into a silently-skipped 1-device CPU run)
+_CHILD_NATIVE = """\
+import sys
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _spawn(tmpdir: str, idx: int, extra_args: list, *, native: bool):
+    # this bench GATES a ~1.3x coalesced-vs-per-request margin; the CI
+    # chaos shard's armed lock tracker (TPUBLOOM_LOCK_CHECK=1, inherited
+    # by subprocesses) taxes the coalescer's queue-condition churn far
+    # more than the per-request path and measurably flips the
+    # comparison — a perf gate must not measure the debug tracker.
+    # Chaos/lock coverage for this path lives in tests/test_ingest.py.
+    drop = ("TPUBLOOM_LOCK_CHECK", "TPUBLOOM_LOCK_CHECK_DIR")
+    if native:
+        return ingest_load._spawn(
+            tmpdir, idx, extra_args, child_src=_CHILD_NATIVE,
+            env_drop=drop + ("JAX_PLATFORMS",),
+        )
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={DEVICES}"
+        ).strip()
+    return ingest_load._spawn(
+        tmpdir, idx, extra_args,
+        env_extra={"XLA_FLAGS": flags}, env_drop=drop,
+    )
+
+
+def _setup_filter(client, name: str, n_devices: int) -> None:
+    """One mesh-spanning blocked512 sharded filter + jit-bucket warm-up
+    (merged flush sizes pad to powers of two in [BATCH, C*BATCH]; each
+    new padded shape is a fresh shard_map compile — without the warm-up
+    the window measures XLA, not ingest)."""
+    client.create_filter(
+        name, capacity=1_000_000, error_rate=0.01,
+        shards=n_devices, block_bits=512,
+    )
+    ingest_load._warm_buckets(client, name)
+
+
+def _measure(addr: str, name: str, duration_s: float, stats_client) -> dict:
+    """ingest_load's measurement with this bench's headline name: the
+    aggregate over the mesh IS keys/sec/pod."""
+    m = ingest_load._measure(addr, name, duration_s, stats_client)
+    m["keys_per_sec_pod"] = m.pop("aggregate_keys_per_sec")
+    m.pop("scaling_vs_linear", None)
+    return m
+
+
+def run_load(
+    duration_s: float = 2.0,
+    *,
+    native: bool = False,
+    coalesce_args: tuple = ("--coalesce-max-keys", "16384",
+                            "--coalesce-max-wait-us", "2000"),
+) -> dict:
+    from tpubloom.server.client import BloomClient
+
+    tmpdir = tempfile.mkdtemp(prefix="tpubloom-multichip-load-")
+    procs: list = []
+    out: dict = {
+        "connections": CONNECTIONS, "batch": ingest_load.BATCH,
+        "duration_s": duration_s,
+    }
+    try:
+        proc, addr = _spawn(tmpdir, 0, list(coalesce_args), native=native)
+        procs.append(proc)
+        boot = BloomClient(addr)
+        boot.wait_ready(timeout=240.0)
+        health = boot.health()
+        n_devices = len(health.get("devices") or ())
+        out["devices"] = n_devices
+        if n_devices < 2:
+            # skip-clean: this backend cannot host a mesh (parity with
+            # cluster_smoke's behavior on unsupported backends)
+            out["skipped"] = (
+                f"backend {health.get('backend')!r} exposes {n_devices} "
+                f"device(s); multichip serving needs >= 2"
+            )
+            boot.close()
+            return out
+        _setup_filter(boot, "pod", n_devices)
+
+        # the per-request control: same mesh, NO coalescer — every RPC
+        # is its own shard_map launch under the filter op lock
+        dproc, daddr = _spawn(tmpdir, 1, [], native=native)
+        procs.append(dproc)
+        direct = BloomClient(daddr)
+        direct.wait_ready(timeout=240.0)
+        _setup_filter(direct, "pod", n_devices)
+
+        def measure_both(window: float) -> None:
+            out.update(_measure(addr, "pod", window, boot))
+            out["per_request_keys_per_sec"] = round(
+                ingest_load._hammer(daddr, "pod", CONNECTIONS, window)
+            )
+            out["scaling_vs_per_request"] = round(
+                out["keys_per_sec_pod"] / out["per_request_keys_per_sec"], 3
+            )
+
+        measure_both(duration_s)
+        if (
+            out["scaling_vs_single"] < GATE_MULTI
+            or out["scaling_vs_per_request"] < GATE_VS_PER_REQUEST
+            or out["requests_per_flush"] <= 1.5
+        ):
+            # one re-measure with a doubled window before failing (the
+            # cluster_smoke discipline: zero-margin comparisons on a
+            # 2-vCPU shared runner deserve a second look, not a red CI)
+            out["remeasured"] = True
+            measure_both(duration_s * 2)
+        boot.close()
+        direct.close()
+        assert out["scaling_vs_single"] >= GATE_MULTI, (
+            f"coalesced mesh aggregate ({out['keys_per_sec_pod']} keys/s "
+            f"over {CONNECTIONS} connections, {n_devices} devices) is only "
+            f"{out['scaling_vs_single']}x one connection "
+            f"({out['single_conn_keys_per_sec']}) — gate {GATE_MULTI}x"
+        )
+        assert out["scaling_vs_per_request"] >= GATE_VS_PER_REQUEST, (
+            f"coalesced sharded ingest ({out['keys_per_sec_pod']} keys/s) "
+            f"lost to the per-request sharded path "
+            f"({out['per_request_keys_per_sec']} keys/s) — the coalescer "
+            f"must FEED the mesh, not slow it down"
+        )
+        assert out["requests_per_flush"] > 1.5, (
+            f"only {out['requests_per_flush']} requests/flush — the gates "
+            f"passed without actual coalescing"
+        )
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    native = "--native-backend" in sys.argv[1:]
+    print(json.dumps(run_load(native=native)))

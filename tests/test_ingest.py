@@ -567,6 +567,321 @@ def test_phase_histogram_exemplars(coalesced_server):
     assert "# {rid=" not in plain
 
 
+# -- multichip: sharded filters through the coalescer (ISSUE 11) --------------
+
+
+def test_sharded_coalesced_exactly_once_under_shard_chaos(coalesced_server):
+    """THE ISSUE-11 chaos acceptance: a mesh-sharded COUNTING filter
+    under concurrent coalesced load with ``shard.insert`` armed — every
+    acked write lands exactly once (zero lost: all acked keys readable;
+    zero doubled: one delete round empties them), and the armed lock
+    tracker (module fixture) reports zero violations. The fault fires
+    BEFORE the shard_map launch, so a failed flush applies nothing and
+    the writers' retries stay exactly-once."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter(
+            "shx", capacity=200_000, error_rate=0.01,
+            shards=8, counting=True,
+        )
+        # predicate chaos: flushes touching shard 2 fail every 2nd pass,
+        # 6 times total — guaranteed chaos AND guaranteed completion
+        faults.arm("shard.insert", "nth:2", pred={"shard": 2}, times=6)
+        acked: list = []
+        acked_lock = threading.Lock()
+        saw_fault: list = []
+
+        def writer(t):
+            def go():
+                with s.client() as c:
+                    for i in range(5):
+                        keys = [b"sx-%d-%d-%d" % (t, i, j) for j in range(40)]
+                        for _attempt in range(40):
+                            try:
+                                assert c.insert_batch("shx", keys) == 40
+                                break
+                            except protocol.BloomServiceError as e:
+                                assert e.code == "INTERNAL", e
+                                saw_fault.append(1)
+                        else:
+                            raise AssertionError("insert never succeeded")
+                        with acked_lock:
+                            acked.append(keys)
+            return go
+
+        try:
+            _threads([writer(t) for t in range(6)])
+        finally:
+            faults.reset()
+        assert len(acked) == 30, "every batch must eventually ack"
+        flat = [k for ks in acked for k in ks]
+        assert admin.include_batch("shx", flat).all(), "acked write lost"
+        admin.delete_batch("shx", flat)
+        doubled = int(admin.include_batch("shx", flat).sum())
+        assert doubled == 0, f"{doubled} acked keys double-applied"
+
+
+def test_sharded_fixed_coalesced_demux(coalesced_server):
+    """Sharded filters ride the zero-copy ``keys_fixed`` encoding and
+    the coalescer's per-request demux (PR-10 excluded them from both)."""
+    s = coalesced_server
+    with s.client() as c:
+        c.create_filter("shq", capacity=200_000, error_rate=0.01, shards=8)
+        present = np.arange(1000, dtype=np.uint64)
+        assert c.insert_batch("shq", present) == 1000
+        assert c._fixed_negotiated is True
+        results = {}
+
+        def reader(t):
+            def go():
+                with s.client() as rc:
+                    mine = np.arange(t * 100, t * 100 + 50, dtype=np.uint64)
+                    absent = mine + 500_000
+                    results[t] = (
+                        rc.include_batch("shq", mine),
+                        rc.include_batch("shq", absent),
+                    )
+            return go
+
+        _threads([reader(t) for t in range(6)])
+        for t, (hit, miss) in results.items():
+            assert hit.all(), f"client {t}: present keys demuxed wrong"
+            assert not miss.any(), f"client {t}: absent keys demuxed wrong"
+        counters = c.stats()["counters"]
+        assert counters["ingest_requests_coalesced"] >= 6
+
+
+def test_sharded_fixed_coalesced_replays_after_restart(tmp_path):
+    """A sharded coalesced flush commits ONE merged keys_fixed record;
+    after a restart the replay re-creates the mesh filter and applies
+    the record exactly once (counting delete proof)."""
+    from tpubloom.repl import OpLog
+
+    d = str(tmp_path / "log")
+    svc = BloomService(
+        oplog=OpLog(d),
+        coalesce=CoalesceConfig(max_keys=4096, max_wait_us=2000),
+    )
+    s = _Server(svc)
+    keys = np.arange(800, dtype=np.uint64)
+    with s.client() as c:
+        c.create_filter(
+            "shr", capacity=100_000, error_rate=0.01,
+            shards=8, counting=True,
+        )
+
+        def writer(lo):
+            def go():
+                with s.client() as w:
+                    w.insert_batch("shr", keys[lo: lo + 400])
+            return go
+
+        _threads([writer(0), writer(400)])
+    s.stop()
+    svc.oplog.close()
+
+    svc2 = BloomService(oplog=OpLog(d))
+    stats = svc2.replay_oplog()
+    assert stats["failed"] == 0
+    s2 = _Server(svc2)
+    with s2.client() as c:
+        assert c.include_batch("shr", keys).all()
+        c.delete_batch("shr", keys)  # 1 - 1 = 0 unless replay doubled
+        doubled = int(c.include_batch("shr", keys).sum())
+        assert doubled == 0, f"{doubled} keys double-applied by replay"
+    s2.stop()
+    svc2.oplog.close()
+
+
+def test_sharded_flush_barrier_and_dedup_rewait(tmp_path):
+    """The one-barrier-per-flush contract holds for mesh-sharded
+    filters: a strict write in a coalesced flush times out
+    NOT_ENOUGH_REPLICAS {applied: true}, its lax flush-mate succeeds,
+    and after the replica acks, a same-rid re-drive answers from the
+    dedup cache and re-waits to success."""
+    from tpubloom.repl import OpLog
+
+    svc = BloomService(
+        oplog=OpLog(str(tmp_path / "log")),
+        coalesce=CoalesceConfig(max_keys=128, max_wait_us=500_000),
+    )
+    svc_sid = svc.repl_sessions.register("silent", listen="127.0.0.1:1")
+    s = _Server(svc)
+    try:
+        with s.client() as admin:
+            admin.create_filter(
+                "shb", capacity=100_000, error_rate=0.01,
+                shards=8, counting=True,
+            )
+            keys_a = [b"sba-%d" % j for j in range(64)]
+            keys_b = [b"sbb-%d" % j for j in range(64)]
+            outcome = {}
+
+            def strict():
+                with s.client() as c:
+                    try:
+                        c.insert_batch(
+                            "shb", keys_a,
+                            min_replicas=1, min_replicas_timeout_ms=300,
+                        )
+                        outcome["strict"] = "ok"
+                    except protocol.BloomServiceError as e:
+                        outcome["strict"] = e
+                    outcome["strict_rid"] = c.last_rid
+
+            def lax():
+                with s.client() as c:
+                    outcome["lax"] = c.insert_batch("shb", keys_b)
+
+            _threads([strict, lax])
+            err = outcome["strict"]
+            assert isinstance(err, protocol.BloomServiceError)
+            assert err.code == "NOT_ENOUGH_REPLICAS"
+            assert err.details["applied"] is True
+            seq = err.details["seq"]
+            assert outcome["lax"] == 64
+            assert admin.include_batch("shb", keys_a + keys_b).all()
+            svc.repl_sessions.ack(svc_sid, seq)
+            with s.client() as c:
+                resp = c._rpc(
+                    "InsertBatch",
+                    {"name": "shb", "keys": keys_a, "min_replicas": 1,
+                     "min_replicas_timeout_ms": 1000},
+                    rid=outcome["strict_rid"],
+                )
+            assert resp["repl_seq"] == seq, "re-drive must hit the dedup cache"
+            assert resp["acked_replicas"] == 1
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
+# -- op-sorted flushes (ISSUE 11 satellite) -----------------------------------
+
+
+def test_presence_split_from_plain_inserts_in_flush(coalesced_server):
+    """A parked insert run mixing presence and plain requests flushes
+    as TWO op-pure launches (the plain half rides the insert-only rate
+    instead of the fused one) — with correct per-request demux and the
+    fused/split mix counters ticking."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter("mix", capacity=200_000, error_rate=0.01)
+        c0 = admin.stats()["counters"]
+        results = {}
+
+        def plain(t):
+            def go():
+                with s.client() as c:
+                    keys = [b"mp-%d-%d" % (t, j) for j in range(40)]
+                    results[f"plain{t}"] = c.insert_batch("mix", keys)
+            return go
+
+        def presence(t):
+            def go():
+                with s.client() as c:
+                    keys = [b"mq-%d-%d" % (t, j) for j in range(40)]
+                    first = c.insert_batch("mix", keys, return_presence=True)
+                    results[f"pres{t}"] = first
+            return go
+
+        _threads([plain(0), plain(1), plain(2), presence(0), presence(1)])
+        for t in range(3):
+            assert results[f"plain{t}"] == 40
+        for t in range(2):
+            assert not results[f"pres{t}"].any(), (
+                "fresh keys must report absent"
+            )
+        c1 = admin.stats()["counters"]
+        assert c1.get("ingest_fused_flushes", 0) > c0.get(
+            "ingest_fused_flushes", 0
+        ), "a presence run must count as a fused launch"
+        # all keys landed regardless of which launch they rode
+        allk = [b"mp-%d-%d" % (t, j) for t in range(3) for j in range(40)]
+        allk += [b"mq-%d-%d" % (t, j) for t in range(2) for j in range(40)]
+        assert admin.include_batch("mix", allk).all()
+
+
+def test_split_flush_failure_does_not_poison_applied_sibling(tmp_path):
+    """Error containment across op-sorted sub-flushes: when the plain
+    half of a split flush has ALREADY applied + logged and is parked on
+    the completer awaiting its barrier, a failure in the presence half
+    must fail ONLY the presence waiters — the plain write's client gets
+    its real quorum verdict (NOT_ENOUGH_REPLICAS {applied: true}), not
+    a generic INTERNAL that would invite a fresh-rid retry and a double
+    apply."""
+    from tpubloom.repl import OpLog
+
+    svc = BloomService(
+        oplog=OpLog(str(tmp_path / "log")),
+        # both 64-key entries must co-park: ripen by size at exactly 128
+        coalesce=CoalesceConfig(max_keys=128, max_wait_us=500_000),
+    )
+    svc.repl_sessions.register("silent", listen="127.0.0.1:1")
+    s = _Server(svc)
+    try:
+        with s.client() as admin:
+            admin.create_filter("split", capacity=100_000, error_rate=0.01)
+            # the presence half of a flat filter runs include_batch +
+            # insert_batch; failing the include fails the presence part
+            # BEFORE anything of it applies, while the plain part is
+            # already launched + logged + barrier-parked
+            mf = svc._filters["split"]
+            real_include = mf.filter.include_batch
+
+            def poisoned_include(keys):
+                if any(k.startswith(b"pr-") for k in keys):
+                    raise RuntimeError("presence-part boom")
+                return real_include(keys)
+
+            mf.filter.include_batch = poisoned_include
+            keys_plain = [b"pl-%d" % j for j in range(64)]
+            keys_pres = [b"pr-%d" % j for j in range(64)]
+            outcome = {}
+
+            def plain():
+                with s.client() as c:
+                    try:
+                        c.insert_batch(
+                            "split", keys_plain,
+                            min_replicas=1, min_replicas_timeout_ms=400,
+                        )
+                        outcome["plain"] = "ok"
+                    except protocol.BloomServiceError as e:
+                        outcome["plain"] = e
+
+            def pres():
+                with s.client() as c:
+                    try:
+                        c.insert_batch(
+                            "split", keys_pres, return_presence=True
+                        )
+                        outcome["pres"] = "ok"
+                    except protocol.BloomServiceError as e:
+                        outcome["pres"] = e
+
+            _threads([plain, pres])
+            perr = outcome["pres"]
+            assert isinstance(perr, protocol.BloomServiceError)
+            assert perr.code == "INTERNAL"
+            err = outcome["plain"]
+            assert isinstance(err, protocol.BloomServiceError), (
+                f"plain write got {err!r}; must reach its own barrier"
+            )
+            assert err.code == "NOT_ENOUGH_REPLICAS", (
+                f"plain write must get its quorum verdict, got {err.code}"
+            )
+            assert err.details["applied"] is True
+            mf.filter.include_batch = real_include
+            assert admin.include_batch("split", keys_plain).all()
+            assert not admin.include_batch("split", keys_pres).any(), (
+                "the failed presence part must not have applied"
+            )
+    finally:
+        s.stop()
+        svc.oplog.close()
+
+
 # -- drain/demotion interplay -------------------------------------------------
 
 

@@ -413,3 +413,52 @@ def test_counting_delete_requires_counting(cfg8):
     f = ShardedBloomFilter(cfg8)
     with pytest.raises(ValueError, match="counting"):
         f.delete_batch([b"x"])
+
+
+# -- staged / packed surface (ISSUE 11) --------------------------------------
+
+
+def test_staged_packed_surface_matches_list_path(cfg8):
+    """insert_packed/include_packed (the ``keys_fixed`` server path) on
+    a mesh filter are bit-identical to the list path, and staging
+    replicates the batch across every mesh device up front."""
+    f = ShardedBloomFilter(cfg8)
+    keys = np.arange(512, dtype=np.uint64)
+    rows = np.frombuffer(keys.tobytes(), np.uint8).reshape(512, 8)
+    assert f.insert_packed(rows) == 512
+    assert f.include_packed(rows).all()
+    g = ShardedBloomFilter(cfg8)
+    g.insert_batch([rows[i].tobytes() for i in range(512)])
+    np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+    # replicated H2D: the staged arrays live on all 8 devices BEFORE
+    # the launch (the broadcast overlaps the previous flush's kernel)
+    staged = f.stage_batch([b"abc", b"def"])
+    assert len(staged[0].sharding.device_set) == 8
+    assert len(staged[1].sharding.device_set) == 8
+
+
+def test_packed_path_fires_shard_fault_points(cfg8):
+    """Lifting the server's staged-path exclusion (ISSUE 11) must not
+    lose the per-shard chaos surface: the packed/staged entry points
+    fire shard.insert / shard.query BEFORE anything applies, honoring
+    shard predicates."""
+    from tpubloom import faults
+
+    f = ShardedBloomFilter(cfg8)
+    rows = np.frombuffer(
+        np.arange(64, dtype=np.uint64).tobytes(), np.uint8
+    ).reshape(64, 8)
+    try:
+        faults.arm("shard.insert", "once")
+        with pytest.raises(faults.InjectedFault):
+            f.insert_packed(rows)
+        assert f.n_inserted == 0, "the fault must fire before the launch"
+        faults.reset()
+        assert f.insert_packed(rows) == 64
+        faults.arm("shard.query", "once")
+        with pytest.raises(faults.InjectedFault):
+            f.include_packed(rows)
+        faults.reset()
+        assert f.include_packed(rows).all()
+    finally:
+        faults.reset()

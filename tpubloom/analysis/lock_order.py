@@ -126,7 +126,13 @@ ALLOWED_EDGES = frozenset(
         # -- ingestion coalescer (ISSUE 10): the queue condition is a
         #    LEAF apart from the parked-keys gauge — the dispatcher
         #    drops it before touching any filter/registry/log lock, and
-        #    the flush itself mints only the existing filter.op edges
+        #    the flush itself mints only the existing filter.op edges.
+        #    ISSUE 11 (sharded filters through the coalescer) adds NO
+        #    new edges by design: the per-shard chaos surface is fault
+        #    POINTS (shard.*), not locks — the staged launches fire
+        #    them under the existing filter.op -> faults.registry edge,
+        #    and the replicated H2D staging is lock-free (verified by
+        #    the armed test_ingest module's manifest diff)
         ("ingest.queue", "obs.counters"),
         # the demotion barrier drains parked coalesced writes under the
         # promote lock (become_replica — see ingest.drain_parked, which

@@ -54,14 +54,18 @@ COUNTERS = (
     "delete_dedup_hits",
     "faults_injected",
     "filters_created",
+    "geometry_probe_compiles",
     "geometry_probe_demotions",
     "ha_demotions",
     "ha_promotions",
     "ha_role_transitions",
     "ingest_fallback_direct",
     "ingest_flushes",
+    "ingest_fused_flushes",
     "ingest_keys_coalesced",
+    "ingest_plain_flushes",
     "ingest_requests_coalesced",
+    "ingest_split_flushes",
     "insert_dedup_hits",
     "keys_deleted",
     "keys_inserted",

@@ -970,6 +970,18 @@ def apply_blocked_updates(
 # erroring at first use.
 _VALIDATED_DEVICE_KINDS = ("TPU v5 lite",)
 _GEOM_PROBE_CACHE: dict = {}
+#: per-device-kind PERSISTENT probe results (ISSUE 11 satellite, ADVICE
+#: r5 #4): a cold start on an unvalidated TPU generation used to pay
+#: ~60 s of speculative Mosaic compiles — and every rolling restart of
+#: a fleet pays it again. Successful probes are written through to
+#: ``$TPUBLOOM_CACHE_DIR`` (default ``~/.cache/tpubloom``), keyed by
+#: device kind, so the second process start performs ZERO speculative
+#: probe compiles. Only ``ok=True`` results persist: a cached FAILURE
+#: would outlive the transient compile-service errors the in-process
+#: retry exists for, silently demoting every future process — a restart
+#: must stay the documented re-probe escape hatch.
+_GEOM_DISK_CACHE: dict = {}  # device kind -> set of ok key strings
+_GEOM_DISK_LOADED: set = set()  # device kinds whose file was read
 # (J, R8, S, KJP) tuples that compiled AND ran bit-exact on v5e
 # hardware this round (adversarial_r5.json, presence_geom_r5.json,
 # kj_slack_r5.json, geom8m_r5.json, bench/b_sweep runs).
@@ -995,38 +1007,173 @@ _VALIDATED_GEOMS = {
 }
 
 
+def _probe_env():
+    """Device kind when probe compiles apply (TPU backend), else None.
+    The one seam between the probe machinery and the hardware — tests
+    monkeypatch it to exercise the cache off-TPU."""
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def _geom_cache_path(kind: str) -> str:
+    import os
+    import re
+
+    base = os.environ.get("TPUBLOOM_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tpubloom"
+    )
+    slug = re.sub(r"[^A-Za-z0-9._-]+", "-", kind)
+    return os.path.join(base, f"geomprobe-{slug}.json")
+
+
+def _geom_cache_salt() -> str:
+    """Version salt invalidating the persisted probe results: a stale
+    ok=True surviving a kernel-code or jax/Mosaic upgrade would skip
+    the probe for a geometry that no longer compiles — converting
+    graceful demotion into a hard runtime failure at first real use.
+    Upgrades cost one re-probe pass instead."""
+    from tpubloom import version
+
+    return f"{version.__version__}|jax-{jax.__version__}"
+
+
+def _geom_disk_get(kind: str, key_str: str) -> bool:
+    """True when a previous PROCESS probed this geometry ok on this
+    device kind AT THIS CODE VERSION (best-effort: any read problem —
+    missing file, torn JSON, CRC mismatch, salt mismatch — reads as a
+    miss)."""
+    if kind not in _GEOM_DISK_LOADED:
+        _GEOM_DISK_LOADED.add(kind)
+        from tpubloom.utils import crcjson
+
+        payload = crcjson.load(_geom_cache_path(kind), ("geoms", "salt"))
+        geoms = payload.get("geoms") if payload else None
+        if payload is None or payload.get("salt") != _geom_cache_salt():
+            geoms = None
+        _GEOM_DISK_CACHE[kind] = set(
+            geoms if isinstance(geoms, list) else ()
+        )
+    return key_str in _GEOM_DISK_CACHE.get(kind, ())
+
+
+def _geom_disk_put(kind: str, key_str: str) -> None:
+    """Write-through one ok probe result. Multi-process safe for the
+    fleet-rolling-restart case the cache exists for: the file is
+    RE-READ and unioned before each write (a sibling process's probes
+    landed between our load and now must not be clobbered), and the
+    write goes through a pid-unique path + ``os.replace`` so two
+    concurrent writers cannot tear each other's tmp file. Best-effort
+    throughout — a read-only cache dir must not break the hot path."""
+    import os
+
+    from tpubloom.utils import crcjson
+
+    _GEOM_DISK_CACHE.setdefault(kind, set()).add(key_str)
+    path = _geom_cache_path(kind)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        merged = set(_GEOM_DISK_CACHE[kind])
+        current = crcjson.load(path, ("geoms", "salt"))
+        if current and current.get("salt") == _geom_cache_salt():
+            geoms = current.get("geoms")
+            if isinstance(geoms, list):
+                merged.update(geoms)
+        _GEOM_DISK_CACHE[kind] = merged
+        mine = f"{path}.{os.getpid()}"
+        crcjson.store(mine, {
+            "geoms": sorted(merged),
+            "salt": _geom_cache_salt(),
+        })
+        os.replace(mine, path)
+    except OSError:
+        pass
+
+
+def _probe_compile(fn, blocks_sds, upd_sds, starts_sds):
+    """One speculative Mosaic AOT compile (counted in
+    ``geometry_probe_compiles``), attempted TWICE before reporting
+    failure: this environment's compile service surfaces transient
+    failures (dropped connections, HTTP 500) as generic exceptions,
+    indistinguishable from a real Mosaic limit — and a cached False
+    silently demotes the process to slower shapes/scatter for its
+    lifetime (ADVICE r5 #2; bench.py retries the same failure mode). A
+    real scoped-VMEM OOM fails both attempts. Returns ``(ok, exc)``."""
+    from tpubloom.obs import counters as obs_counters
+
+    obs_counters.incr("geometry_probe_compiles")
+    ok, last_exc = False, None
+    for _attempt in range(2):
+        try:
+            jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
+            ok = True
+            break
+        except Exception as e:  # noqa: BLE001 — any compile failure demotes
+            last_exc = e
+    return ok, last_exc
+
+
+_VALIDATED_KBJP_CAPS: dict = {}
+
+
+def _validated_kbjp_cap(kind_name: str, sig) -> int:
+    """Largest packed big-fetch row count (kbjp) any chooser-reachable
+    lambda can pair with this validated (J, R8, S, KJP) signature —
+    ADVICE r5 #3: the window-fetch scratch ``2*J*kbjp*128*4`` is part
+    of the hardware-validated footprint, so a geometry whose kbjp
+    exceeds what the signature pins must probe instead of riding the
+    fast path. Derived by inverting the chooser's KJ(lambda) step
+    function (slack 6 for presence, 8 otherwise) over the feasible
+    lambda range; memoized — ~2k-iteration integer scan, once per
+    signature per process."""
+    cached = _VALIDATED_KBJP_CAPS.get((kind_name, sig))
+    if cached is not None:
+        return cached
+    import math
+
+    J, R8, S, KJP = sig
+    w = 128 // J
+    presence = kind_name == "presence"
+    pk = fat_pack(w, presence)
+    slack = 6 if presence else 8
+    cap = 0
+    for lam in range(8, 2049):
+        kj = max(16, (lam + max(16, int(slack * math.sqrt(lam))) + 7) // 8 * 8)
+        if kj > 1024 or _packed_rows(kj, pk) != KJP:
+            continue
+        kbj = ((lam * S + kj + 64 + 7) // 8) * 8
+        cap = max(cap, _packed_rows(kbj, pk))
+    _VALIDATED_KBJP_CAPS[(kind_name, sig)] = cap
+    return cap
+
+
 def _fat_geometry_compiles(
-    nb: int, w: int, geom, *, presence: bool, counting: bool
+    nb: int, w: int, geom, *, presence: bool, counting: bool,
+    batch: int | None = None,
 ) -> bool:
     """True if the fat kernel at ``geom`` compiles on the current device.
 
     On v5e, insert geometries inside the caps always pass (no insert
     OOM was ever measured inside them), and presence/counting
-    geometries pass if listed in ``_VALIDATED_GEOMS``; anything else —
-    and everything on other TPU generations — is lowered + compiled AOT
-    against ShapeDtypeStructs (no operand allocation) in a try/except,
-    one compile per geometry per process. CPU/GPU backends return True
-    unchanged: the sweep path is never auto-selected off-TPU, and tests
-    drive the kernel in interpret mode where Mosaic limits don't
-    apply."""
-    try:
-        if jax.default_backend() != "tpu":
-            return True
-        kind = jax.devices()[0].device_kind
-    except Exception:
+    geometries pass if listed in ``_VALIDATED_GEOMS`` with a big-fetch
+    footprint the signature pins (:func:`_validated_kbjp_cap`); anything
+    else — and everything on other TPU generations — is lowered +
+    compiled AOT against ShapeDtypeStructs (no operand allocation) in a
+    try/except. With ``batch`` the probe's update buffer carries the
+    REAL runtime row count (ADVICE r5 #1 — the compile is then
+    shape-identical to the first real call, so a passing probe cannot
+    hide an operand-extent-dependent failure); results are cached per
+    process AND per device kind on disk (ok only — see the
+    ``_GEOM_DISK_CACHE`` note). CPU/GPU backends return True unchanged:
+    the sweep path is never auto-selected off-TPU, and tests drive the
+    kernel in interpret mode where Mosaic limits don't apply."""
+    kind = _probe_env()
+    if kind is None:
         return True
     J, R8, S, KJ, KBJ = geom
-    if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
-        if not (presence or counting):
-            return True
-        sig = (J, R8, S, _packed_rows(KJ, fat_pack(w, presence)))
-        if sig in _VALIDATED_GEOMS["presence" if presence else "counting"]:
-            return True
-    key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting)
-    hit = _GEOM_PROBE_CACHE.get(key)
-    if hit is not None:
-        return hit
-    NBJ = nb // J
     # pack must match the kernel the runtime will launch: both the
     # chooser's volume bound and apply_fat_counter_updates use
     # fat_pack(w, presence) — probing a pack=1 counting kernel would
@@ -1034,8 +1181,34 @@ def _fat_geometry_compiles(
     # PACK=4 unroll
     pk = fat_pack(w, presence)
     kbjp = _packed_rows(KBJ, pk)
+    if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
+        if not (presence or counting):
+            return True
+        kname = "presence" if presence else "counting"
+        sig = (J, R8, S, _packed_rows(KJ, pk))
+        if sig in _VALIDATED_GEOMS[kname] and kbjp <= _validated_kbjp_cap(
+            kname, sig
+        ):
+            return True
+    # update-stream rows exactly as _fat_stream will build them at
+    # runtime; probes with no batch at hand keep the legacy stand-in
+    if batch is None:
+        upd_rows = kbjp + 16
+    elif pk == 1:
+        upd_rows = int(batch) + KBJ + _ALIGN
+    else:
+        upd_rows = -(-int(batch) // pk) + kbjp + _ALIGN
+    key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting, upd_rows)
+    hit = _GEOM_PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    key_str = "/".join(map(str, key[1:]))  # kind is the file, not the key
+    if _geom_disk_get(kind, key_str):
+        _GEOM_PROBE_CACHE[key] = True
+        return True
+    NBJ = nb // J
     blocks_sds = jax.ShapeDtypeStruct((NBJ, 128), jnp.uint32)
-    upd_sds = jax.ShapeDtypeStruct((kbjp + 16, 128), jnp.uint32)
+    upd_sds = jax.ShapeDtypeStruct((upd_rows, 128), jnp.uint32)
     starts_sds = jax.ShapeDtypeStruct((J * (NBJ // R8) + 1,), jnp.int32)
     if counting:
         fn = functools.partial(
@@ -1047,20 +1220,7 @@ def _fat_geometry_compiles(
             fat_sweep_insert, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
             with_presence=presence, pack=pk,
         )
-    # Two attempts before caching ok=False: this environment's compile
-    # service surfaces transient failures (dropped connections, HTTP 500)
-    # as generic exceptions, indistinguishable from a real Mosaic limit —
-    # and a cached False silently demotes the process to slower
-    # shapes/scatter for its lifetime (ADVICE r5 #2; bench.py retries the
-    # same failure mode). A real scoped-VMEM OOM fails both attempts.
-    ok, last_exc = False, None
-    for attempt in range(2):
-        try:
-            jax.jit(fn).lower(blocks_sds, upd_sds, starts_sds).compile()
-            ok = True
-            break
-        except Exception as e:  # noqa: BLE001 — any compile failure demotes
-            last_exc = e
+    ok, last_exc = _probe_compile(fn, blocks_sds, upd_sds, starts_sds)
     if not ok:
         import warnings
 
@@ -1076,12 +1236,15 @@ def _fat_geometry_compiles(
             f"disabled for the process (falling back to the next "
             f"shape / scatter path). NOTE: the probe cannot tell a "
             f"real Mosaic limit from a persistent compile-service "
-            f"error — restart the process to re-probe. Cause: "
-            f"{str(last_exc)[:300]}",
+            f"error — restart the process to re-probe (failures are "
+            f"deliberately NOT written to the on-disk probe cache). "
+            f"Cause: {str(last_exc)[:300]}",
             RuntimeWarning,
             stacklevel=2,
         )
     _GEOM_PROBE_CACHE[key] = ok
+    if ok:
+        _geom_disk_put(kind, key_str)
     return ok
 
 
@@ -1227,7 +1390,8 @@ def choose_fat_params(
             ):
                 geom = (J, R8, s, KJ, kbj)
                 if not _fat_geometry_compiles(
-                    nb, w, geom, presence=presence, counting=counting
+                    nb, w, geom, presence=presence, counting=counting,
+                    batch=batch,
                 ):
                     continue  # unvalidated device generation: next shape
                 return geom

@@ -54,6 +54,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpubloom import faults
 from tpubloom.config import FilterConfig
 from tpubloom.filter import _FilterBase
+from tpubloom.obs import context as obs
 from tpubloom.ops import bitops, blocked, counting, hashing
 from tpubloom.utils.packing import redis_bitmap_to_words, words_to_redis_bitmap
 
@@ -628,15 +629,19 @@ class ShardedBloomFilter(_FilterBase):
 
     # -- per-shard fault points (ISSUE 4 satellite) --------------------------
 
-    def _fire_shard_faults(self, point: str, keys) -> None:
-        """Chaos hook: fire ``point`` once per shard this batch routes
-        to, with ``shard=<index>`` context — an armed ``shard=N``
-        predicate turns it into a PARTIAL failure (batches that touch
-        shard N fail, everything else proceeds). Disarmed cost is one
-        dict lookup; the host-side routing hash only runs while armed."""
+    def _fire_shard_faults_packed(self, point: str, keys_u8, lengths) -> None:
+        """Chaos hook over ALREADY-PACKED host arrays: fire ``point``
+        once per shard this batch routes to, with ``shard=<index>``
+        context — an armed ``shard=N`` predicate turns it into a
+        PARTIAL failure (batches that touch shard N fail, everything
+        else proceeds). Disarmed cost is one dict lookup; the host-side
+        routing hash only runs while armed. This is the staged/packed
+        paths' hook (ISSUE 11: lifting the coalescer exclusion required
+        every sharded entry point, not just the list-path overrides, to
+        keep the ``shard.*`` chaos surface)."""
         if not faults.is_armed(point):
             return
-        keys_u8, lengths, _ = self._pack_padded(keys)
+        lengths = np.asarray(lengths)
         routes = np.asarray(
             hashing.route_shards(
                 jnp.asarray(keys_u8),
@@ -651,6 +656,14 @@ class ShardedBloomFilter(_FilterBase):
         for shard in touched:
             faults.fire(point, shard=shard)
 
+    def _fire_shard_faults(self, point: str, keys) -> None:
+        """List-path chaos hook — packs, then routes (see
+        :meth:`_fire_shard_faults_packed`)."""
+        if not faults.is_armed(point):
+            return
+        keys_u8, lengths, _ = self._pack_padded(keys)
+        self._fire_shard_faults_packed(point, keys_u8, lengths)
+
     def insert_batch(self, keys, **kwargs):
         self._fire_shard_faults("shard.insert", keys)
         return super().insert_batch(keys, **kwargs)
@@ -658,6 +671,57 @@ class ShardedBloomFilter(_FilterBase):
     def include_batch(self, keys):
         self._fire_shard_faults("shard.query", keys)
         return super().include_batch(keys)
+
+    # -- staged / packed surface (ISSUE 11) ----------------------------------
+    #
+    # The single-chip staged pipeline (filter._FilterBase.stage_batch /
+    # launch_insert / launch_query) applies to the mesh unchanged — the
+    # jitted shard_map kernels take the same (keys_u8, lengths) operands
+    # — but the server excluded sharded filters from it (PR 10) because
+    # the raw launches would bypass the per-shard ``shard.*`` fault
+    # points above. These overrides restore that chaos surface: the
+    # staged tuple carries the HOST arrays alongside the device handles,
+    # and every launch fires the routed fault points before dispatch.
+    # Staging also replicates the batch across the mesh explicitly
+    # (device_put under the h2d phase), so the replication transfer
+    # happens while the PREVIOUS flush's kernel is still in flight —
+    # the coalescer's double buffering, mesh edition.
+
+    #: tells the server's ``_staged_ok`` gate that the staged/packed
+    #: fast paths preserve this filter's fault-point semantics
+    staged_fault_points = True
+
+    def _stage_batch(self, keys_u8, lengths):
+        """Replicated H2D: place the batch on every mesh device now,
+        split from the shard_map launch (the base class's single-device
+        ``jnp.asarray`` would defer the broadcast into the launch)."""
+        with obs.phase("h2d"):
+            rep = NamedSharding(self.mesh, P())
+            return (
+                jax.device_put(np.ascontiguousarray(keys_u8), rep),
+                jax.device_put(np.ascontiguousarray(lengths), rep),
+            )
+
+    def stage_batch(self, keys=None, *, rows=None):
+        """Staged batch that ALSO carries the packed host arrays — the
+        launch-side fault hooks route them without a second packing
+        pass. Opaque to callers (launch_* unpack it)."""
+        if rows is not None:
+            keys_u8, lengths, B = self._prep_packed(np.asarray(rows, np.uint8))
+        else:
+            keys_u8, lengths, B = self._pack_padded(keys)
+        d_keys, d_lengths = self._stage_batch(keys_u8, lengths)
+        return d_keys, d_lengths, B, keys_u8, lengths
+
+    def launch_insert(self, staged):
+        d_keys, d_lengths, B, keys_u8, lengths = staged
+        self._fire_shard_faults_packed("shard.insert", keys_u8, lengths)
+        return super().launch_insert((d_keys, d_lengths, B))
+
+    def launch_query(self, staged):
+        d_keys, d_lengths, B, keys_u8, lengths = staged
+        self._fire_shard_faults_packed("shard.query", keys_u8, lengths)
+        return super().launch_query((d_keys, d_lengths, B))
 
     # delete (counting configs only — configs 4 x 5)
 

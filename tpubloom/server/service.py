@@ -1525,12 +1525,14 @@ class BloomService:
     @staticmethod
     def _staged_ok(mf: _Managed) -> bool:
         """Whether the filter may take the staged/packed fast paths.
-        Sharded filters are excluded: their ``insert_batch``/
-        ``include_batch`` overrides fire the per-shard fault points
-        (``shard.*``), which the raw kernel launch would bypass."""
-        return (
-            hasattr(mf.filter, "stage_batch")
-            and getattr(mf.filter.config, "shards", 1) <= 1
+        Single-chip filters always may; sharded filters may since ISSUE
+        11 — their staged overrides fire the per-shard ``shard.*``
+        fault points themselves and stage a REPLICATED H2D split from
+        the shard_map launch (``staged_fault_points`` marks that the
+        raw launch no longer bypasses the chaos surface)."""
+        return hasattr(mf.filter, "stage_batch") and (
+            getattr(mf.filter.config, "shards", 1) <= 1
+            or getattr(mf.filter, "staged_fault_points", False)
         )
 
     @classmethod

@@ -170,11 +170,28 @@ def _run_bench(platform: str) -> dict:
     qry_jit = jax.jit(qry_step)
     carry = qry_jit(blk_state, jnp.uint32(0), 0)
     _ = int(np.asarray(carry))
+    # --profile-dir (ISSUE 12): dump a jax.profiler trace of the
+    # query-only loop with per-step TraceAnnotations — the occupancy
+    # evidence ROADMAP item 2 asks for (open in Perfetto/XProf; the
+    # per-PHASE stage breakdown lives in benchmarks/profile_query.py).
+    # Profiling adds tracer overhead, so the profiled loop's rate is
+    # flagged rather than silently recorded as a clean number.
+    profile_dir = os.environ.get("TPUBLOOM_BENCH_PROFILE_DIR")
     t0 = time.perf_counter()
-    for i in range(1, 1 + half_steps):
-        carry = qry_jit(blk_state, carry, i)
-    _ = int(np.asarray(carry))
-    query_only_rate = B * half_steps / (time.perf_counter() - t0)
+    if profile_dir:
+        from tpubloom.utils import tracing
+
+        with tracing.trace(os.path.join(profile_dir, "query_only")):
+            for i in range(1, 1 + half_steps):
+                with tracing.annotate("query_only_step", i=i, batch=B):
+                    carry = qry_jit(blk_state, carry, i)
+            _ = int(np.asarray(carry))
+    else:
+        for i in range(1, 1 + half_steps):
+            carry = qry_jit(blk_state, carry, i)
+        _ = int(np.asarray(carry))
+    kernel_query_s = time.perf_counter() - t0
+    query_only_rate = B * half_steps / kernel_query_s
 
     # -- reference-compatible flat layout (the Redis-bitmap position spec)
     config = FilterConfig(m=1 << log2m, k=7, key_len=key_len)
@@ -239,9 +256,10 @@ def _run_bench(platform: str) -> dict:
     probe = jax.random.bits(jax.random.key(10_000_019), (B, key_len), jnp.uint8)
     fpr = float(np.asarray(query_jit(blk_state, probe, lengths)).mean())
 
-    from tpubloom.ops.sweep import resolve_insert_path
+    from tpubloom.ops.sweep import effective_query_path, resolve_insert_path
 
     insert_path = resolve_insert_path(blk_config, B)
+    query_path = effective_query_path(blk_config, B)
     return {
         "metric": f"batched insert+query keys/sec/chip @ m=2^{log2m}, k=7",
         "value": round(blk_rate),
@@ -252,9 +270,15 @@ def _run_bench(platform: str) -> dict:
         "layout": "blocked512",
         "op": "fused test-and-insert (pre-batch membership + insert per key)",
         "insert_path": insert_path,
+        "query_path": query_path,
         "split_keys_per_sec": round(split_rate),
         "insert_only_keys_per_sec": round(insert_only_rate),
+        # the read-path trajectory (ISSUE 12): BENCH rounds track the
+        # query-only rate and its loop time from r06 on, so the query
+        # kernel's effect is a first-class number next to kernel_s
         "query_only_keys_per_sec": round(query_only_rate),
+        "kernel_query_s": round(kernel_query_s, 4),
+        "query_profiled": bool(profile_dir),
         "m": blk_config.m,
         "k": blk_config.k,
         "batch": B,
@@ -312,6 +336,18 @@ def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         _child_main()
         return
+    # --profile-dir <path>: capture a jax.profiler trace of the measured
+    # loops (per-step TraceAnnotations; benchmarks/profile_query.py has
+    # the per-STAGE harness). Passed to the child via the environment so
+    # the subprocess isolation keeps working unchanged.
+    if "--profile-dir" in sys.argv:
+        i = sys.argv.index("--profile-dir")
+        if i + 1 >= len(sys.argv):
+            print("--profile-dir needs a path", file=sys.stderr)
+            raise SystemExit(2)
+        os.environ["TPUBLOOM_BENCH_PROFILE_DIR"] = os.path.abspath(
+            sys.argv[i + 1]
+        )
     attempts = []
     result, err = _spawn("tpu", TPU_TIMEOUT_S)
     if result is None and not err.startswith("timeout after"):

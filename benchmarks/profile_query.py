@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Per-stage breakdown + trace-annotation harness for the QUERY pipeline
+(ISSUE 12 — the profiler pass ROADMAP item 2 asks for).
+
+Two jobs in one tool:
+
+1. **Stage deltas** (the profile_fat.py methodology, read path edition):
+   cumulative-prefix steps with TO-VALUE timing (block_until_ready can
+   lie on this stack — benchmarks/RESULTS_r3.md §1), so each stage's
+   delta is honest wall time:
+
+     Q0 keygen       device RNG [B, 16] u8
+     Q1 +hash        block_positions (3x murmur + fnv)
+     Q2 +sort        skey + packed positions + idx (4-col lax.sort)
+     Q3 +masks       unpack + build_masks [B, W]
+     Q4 +stream      _fat_stream ([BtotP, 128] buffer) + starts
+     Q5 +kernel      fat_sweep_query (read-only Pallas sweep)
+     Q6 full query   apply_fat_query (+ unsort + overflow cond)
+     G  gather ref   the XLA row-gather query (the path Q6 replaces)
+
+   plus kernel-only on a prebuilt stream and the unsort in isolation.
+   Every run carries a per-step ``TraceAnnotation`` (the stage name +
+   step index), so with ``--profile-dir`` the stages are findable in
+   the Perfetto/XProf timeline next to the XLA ops they dispatched —
+   this is the occupancy evidence for the r05 ``kernel_s`` 1.87→2.92 s
+   batch-doubling regression: compare the per-window device occupancy
+   of two traces taken at ``--b4m`` vs the default B=8M.
+
+2. **Bit-exactness**: the harness VERIFIES Q6's verdicts against the
+   gather reference on the same keys/state before timing anything — a
+   profiling run can never report a fast wrong kernel.
+
+CPU-runnable (interpret mode, reduced shape) so CI and dev boxes can
+exercise the harness; the real numbers come from a TPU run at the
+north-star shape. Run:
+
+    timeout 2400 python -m benchmarks.profile_query [--b4m] \
+        [--profile-dir /tmp/qtrace]
+
+Writes ``benchmarks/out/profile_query_<backend>.json`` (one JSON object
+per line); ``--profile-dir`` additionally dumps a loadable
+``jax.profiler`` trace per stage group.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import make_blocked_query_fn
+from tpubloom.ops import blocked
+from tpubloom.ops.sweep import (
+    _fat_stream,
+    _fat_unsort_presence,
+    _pack_positions,
+    _packed_rows,
+    _unpack_positions,
+    apply_fat_query,
+    choose_fat_query_params,
+    fat_pack,
+    fat_sweep_query,
+)
+from tpubloom.utils import tracing
+
+ON_TPU = jax.default_backend() == "tpu"
+if ON_TPU:
+    LOG2M = 32
+    B = 1 << 22 if "--b4m" in sys.argv else 1 << 23
+    STEPS = 16
+else:
+    # CPU harness shape: big enough that choose_fat_query_params
+    # qualifies, small enough that interpret mode finishes in seconds
+    LOG2M = 22  # NB = 8192 at bb=512
+    B = 1 << 13
+    STEPS = 2
+KEY_LEN = 16
+PROFILE_DIR = None
+if "--profile-dir" in sys.argv:
+    PROFILE_DIR = os.path.abspath(sys.argv[sys.argv.index("--profile-dir") + 1])
+
+config = FilterConfig(m=1 << LOG2M, k=7, key_len=KEY_LEN, block_bits=512)
+NB, W, K, BB = config.n_blocks, config.words_per_block, config.k, config.block_bits
+PARAMS = choose_fat_query_params(NB, B, W)
+assert PARAMS is not None, f"query chooser rejected the harness shape NB={NB} B={B}"
+J, R8, S, KJ, KBJ = PARAMS
+PACK = fat_pack(W, True)  # query streams carry the idx column
+KJP = _packed_rows(KJ, PACK)
+NBJ = NB // J
+P8 = NBJ // R8
+FAT_SHAPE = (NB * W // 128, 128)
+INTERP = not ON_TPU
+lengths = jnp.full((B,), KEY_LEN, jnp.int32)
+
+OUT_PATH = os.path.join(
+    os.path.dirname(__file__), "out",
+    f"profile_query_{jax.default_backend()}.json",
+)
+_rows = []
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+    _rows.append(obj)
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _maybe_trace(name):
+    if PROFILE_DIR is None:
+        return contextlib.nullcontext()
+    return tracing.trace(os.path.join(PROFILE_DIR, name))
+
+
+def keygen(carry, i):
+    return jax.random.bits(
+        jax.random.key(i ^ (carry & 0xFFFF)), (B, KEY_LEN), jnp.uint8
+    )
+
+
+def _positions(keys):
+    return blocked.block_positions(
+        keys, lengths, n_blocks=NB, block_bits=BB, k=K, seed=config.seed,
+        block_hash=config.block_hash,
+    )
+
+
+def _sorted_cols(keys):
+    blk, bit = _positions(keys)
+    valid = jnp.ones((B,), bool)
+    blkv = jnp.where(valid, blk, NB)
+    j_of = (blkv % J).astype(jnp.uint32)
+    rf_of = (blkv // J).astype(jnp.uint32)
+    skey = jnp.where(valid, j_of * NBJ + rf_of, _u32(J * NBJ))
+    cols, nbits, packed = _pack_positions(bit, BB, K)
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)
+    return lax.sort((skey,) + cols + (idx0,), num_keys=1), nbits, packed
+
+
+def _stream(keys):
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    ss = sorted_cols[0]
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return _fat_stream(
+        ss, masks, sorted_cols[-1], J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ,
+        W=W, pack=PACK,
+    )
+
+
+def q0(state, carry, i):
+    keys = keygen(carry, i)
+    return jnp.sum(keys, dtype=jnp.uint32)
+
+
+def q1(state, carry, i):
+    keys = keygen(carry, i)
+    blk, bit = _positions(keys)
+    return jnp.sum(blk.astype(jnp.uint32)) + jnp.sum(bit)
+
+
+def q2(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, _, _ = _sorted_cols(keys)
+    return sum(jnp.sum(c) for c in sorted_cols)
+
+
+def q3(state, carry, i):
+    keys = keygen(carry, i)
+    sorted_cols, nbits, packed = _sorted_cols(keys)
+    bit_sorted = _unpack_positions(sorted_cols[1:-1], BB, K, nbits, packed)
+    masks = blocked.build_masks(bit_sorted, W)
+    return jnp.sum(masks) + jnp.sum(sorted_cols[0])
+
+
+def q4(state, carry, i):
+    keys = keygen(carry, i)
+    upd, starts = _stream(keys)
+    return jnp.sum(upd, dtype=jnp.uint32) + jnp.sum(starts).astype(jnp.uint32)
+
+
+def q5(state, carry, i):
+    keys = keygen(carry, i)
+    upd, starts = _stream(keys)
+    presb = fat_sweep_query(
+        state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+        interpret=INTERP, pack=PACK,
+    )
+    return jnp.sum(presb, dtype=jnp.uint32)
+
+
+def q6(state, carry, i):
+    keys = keygen(carry, i)
+    blk, bit = _positions(keys)
+    hits = apply_fat_query(
+        state, blk, bit, jnp.ones((B,), bool),
+        block_bits=BB, params=PARAMS, interpret=INTERP, storage_fat=True,
+    )
+    return jnp.sum(hits.astype(jnp.uint32))
+
+
+_gather_query = make_blocked_query_fn(
+    config.replace(query_path="gather"), storage_fat=True
+)
+
+
+def gref(state, carry, i):
+    keys = keygen(carry, i)
+    hits = _gather_query(state, keys, lengths)
+    return jnp.sum(hits.astype(jnp.uint32))
+
+
+def run(name, step, state, steps=STEPS):
+    """Chained to-value loop with one TraceAnnotation per step — the
+    annotation is the handle that correlates this stage's host dispatch
+    with its device ops in a --profile-dir trace."""
+    jit = jax.jit(step)
+    t0 = time.perf_counter()
+    carry = jit(state, _u32(0), 0)
+    int(np.asarray(carry))  # to-value: compile + first step
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(1, 1 + steps):
+        with tracing.annotate(name, i=i, batch=B):
+            carry = jit(state, carry, i)
+    val = int(np.asarray(carry))  # ONE host fetch after the chained loop
+    dt = (time.perf_counter() - t0) / steps
+    emit({
+        "stage": name,
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+        "compile_s": round(compile_s, 1),
+        "carry": val & 0xFFFF,
+    })
+    return dt
+
+
+def kernel_only(state):
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    upd, starts = jax.jit(_stream)(keys)
+    int(np.asarray(starts[0]))
+
+    def step(state, upd, starts):
+        presb = fat_sweep_query(
+            state, upd, starts, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=W,
+            interpret=INTERP, pack=PACK,
+        )
+        return jnp.sum(presb, dtype=jnp.uint32)
+
+    jit = jax.jit(step)
+    carry = jit(state, upd, starts)
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        with tracing.annotate("kernel_only", i=i):
+            carry = jit(state, upd, starts)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    emit({
+        "stage": "kernel_only(prebuilt stream)",
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+    })
+
+
+def unsort_only():
+    P = P8 // S
+    presb = jax.random.bits(jax.random.key(3), (P * PACK * KJP, 128), jnp.uint32)
+    keys = jax.device_put(
+        np.random.default_rng(0).integers(0, 256, (B, KEY_LEN), np.uint8)
+    )
+    _, starts = jax.jit(_stream)(keys)
+
+    def step(presb, carry):
+        pres = _fat_unsort_presence(
+            presb ^ carry, starts, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
+            KJ=PACK * KJP, KBJ=KBJ,
+        )
+        return jnp.sum(pres.astype(jnp.uint32))
+
+    jit = jax.jit(step)
+    carry = jit(presb, _u32(0))
+    int(np.asarray(carry))
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        with tracing.annotate("unsort_only", i=i):
+            carry = jit(presb, carry)
+    int(np.asarray(carry))
+    dt = (time.perf_counter() - t0) / STEPS
+    emit({
+        "stage": "unsort_only(vkey single-col sort)",
+        "ms_per_step": round(dt * 1e3, 3),
+        "ns_per_key": round(dt / B * 1e9, 3),
+        "rows_sorted": J * P8 * PACK * KJP,
+    })
+
+
+def verify(state):
+    """Bit-exactness gate BEFORE any timing: Q6 vs the gather reference
+    on the same keys/state (uniform + duplicate-skew). A profiling run
+    must never report a fast wrong kernel."""
+    rng = np.random.default_rng(7)
+    for tag, arr in (
+        ("uniform", rng.integers(0, 256, (B, KEY_LEN), np.uint8)),
+        ("dup-skew", np.tile(
+            rng.integers(0, 256, (16, KEY_LEN), np.uint8), (B // 16, 1)
+        )),
+    ):
+        keys = jnp.asarray(arr)
+        blk, bit = _positions(keys)
+        got = apply_fat_query(
+            state, blk, bit, jnp.ones((B,), bool),
+            block_bits=BB, params=PARAMS, interpret=INTERP, storage_fat=True,
+        )
+        want = _gather_query(state, keys, lengths)
+        assert bool((np.asarray(got) == np.asarray(want)).all()), (
+            f"query kernel verdicts diverge from the gather reference ({tag})"
+        )
+    emit({"verified": "sweep query bit-exact vs gather (uniform + dup-skew)"})
+
+
+def main():
+    emit({
+        "shape": {
+            "m": config.m, "k": K, "B": B, "block_bits": BB, "n_blocks": NB,
+            "W": W, "J": J, "R8": R8, "S": S, "KJ": KJ, "KBJ": KBJ,
+            "pack": PACK, "lambda": B * R8 // NB,
+            "platform": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "interpret": INTERP,
+            "timing": "to-value (int(np.asarray(carry)) after chained loop)",
+        }
+    })
+    # a ~quarter-full filter so verdicts are a hit/miss mix (an all-zero
+    # array answers every probe False and hides compare work)
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, FAT_SHAPE, np.uint64).astype(np.uint32)
+        & rng.integers(0, 1 << 32, FAT_SHAPE, np.uint64).astype(np.uint32)
+        & rng.integers(0, 1 << 32, FAT_SHAPE, np.uint64).astype(np.uint32)
+    )
+    verify(state)
+    prev = 0.0
+    deltas = {}
+    stages = [
+        ("Q0 keygen", q0), ("Q1 +hash", q1), ("Q2 +sort", q2),
+        ("Q3 +masks", q3), ("Q4 +stream", q4), ("Q5 +kernel", q5),
+        ("Q6 full query", q6),
+    ]
+    with _maybe_trace("stages"):
+        for name, fn in stages:
+            dt = run(name, fn, state)
+            deltas[name] = dt - prev
+            prev = dt
+        gdt = run("G gather reference", gref, state)
+    emit({
+        "deltas_ms": {k: round(v * 1e3, 3) for k, v in deltas.items()},
+        "query_keys_per_sec": round(B / prev),
+        "gather_keys_per_sec": round(B / gdt),
+        "speedup_vs_gather": round(gdt / prev, 3),
+    })
+    with _maybe_trace("kernel"):
+        kernel_only(state)
+        unsort_only()
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        for r in _rows:
+            f.write(json.dumps(r) + "\n")
+    if PROFILE_DIR:
+        emit({"profile_dir": PROFILE_DIR})
+
+
+if __name__ == "__main__":
+    if not ON_TPU:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    main()

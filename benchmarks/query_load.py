@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Query-path load gate (ISSUE 12) — the read-side twin of ingest_load.
+
+Three acceptance checks in one CPU-runnable tool:
+
+1. **Path selection**: ``resolve_query_path`` must pick the dedicated
+   read-only query sweep kernel for the north-star shape on a TPU
+   backend (the chooser math is backend-independent; the probe compile
+   no-ops off-TPU, so this asserts the CHOOSER, which is what decides
+   on hardware).
+2. **Bit-exactness**: the query kernel (interpret mode on CPU) must
+   answer verdict-identical membership to the XLA gather reference —
+   uniform keys, duplicate-skew keys (the overflow→gather fallback),
+   and tail padding.
+3. **Served read throughput**: a real subprocess server with the
+   ingestion coalescer, hammered with concurrent ``QueryBatch``
+   traffic, must beat the per-request path (a second server without
+   the coalescer) — re-measured once with a doubled window before
+   failing, with a requests/flush anti-gaming assert so the gate can't
+   pass without actual query coalescing (the query-only flushes land
+   in ``ingest_query_flushes``).
+
+Run directly (prints one JSON line) or via tier-1
+(``tests/test_query_kernel.py::test_query_load_smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (script runs)
+
+import ingest_load  # noqa: E402 — shared _spawn/_free_port/BATCH helpers
+
+#: concurrent query connections in each aggregate phase.
+CONNECTIONS = 8
+BATCH = 64
+#: the coalesced read path must AT LEAST match the per-request path
+#: (ISSUE 12 acceptance); on this CPU image it clears it comfortably —
+#: every per-request query pays decode + lock + jit dispatch alone.
+GATE = 1.0
+#: keys preloaded into the filter so query verdicts are a hit/miss mix.
+POPULATION = 1 << 14
+
+
+def _kernel_path_checks() -> dict:
+    """Sections 1 + 2: chooser selection + bit-exactness (in-process)."""
+    import jax.numpy as jnp
+
+    from tpubloom.config import FilterConfig
+    from tpubloom.ops import blocked, sweep
+
+    # 1. the north-star shape must resolve to the query kernel on TPU
+    north = FilterConfig(m=1 << 32, k=7, key_len=16, block_bits=512)
+    path = sweep.resolve_query_path(north, 1 << 23, backend="tpu")
+    assert path == "sweep", (
+        f"north-star shape resolved query_path={path!r} — the dedicated "
+        f"query kernel must be selected for served QueryBatch traffic"
+    )
+    params = sweep.choose_fat_query_params(north.n_blocks, 1 << 23, 16)
+
+    # 2. bit-exactness at a CPU-sized shape (interpret mode)
+    nb, bb, k, b = 8192, 512, 7, 8192
+    cfg = FilterConfig(m=nb * bb, k=k, key_len=16, block_bits=bb)
+    w = cfg.words_per_block
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 256, (b, 16), np.uint8))
+    lengths = jnp.full((b,), 16, jnp.int32)
+    blk, bit = blocked.block_positions(
+        keys, lengths, n_blocks=nb, block_bits=bb, k=k, seed=cfg.seed,
+        block_hash=cfg.block_hash,
+    )
+    masks = blocked.build_masks(bit, w)
+    state = blocked.blocked_insert(
+        jnp.zeros((nb, w), jnp.uint32), blk, masks, jnp.arange(b) < b // 2
+    )
+    small = sweep.choose_fat_query_params(nb, b, w)
+    assert small is not None
+    cases = {"uniform": (keys, lengths)}
+    dup = jnp.asarray(np.tile(rng.integers(0, 256, (16, 16), np.uint8), (b // 16, 1)))
+    cases["dup-skew"] = (dup, lengths)
+    cases["tail-pad"] = (keys, lengths.at[b - 64:].set(-1))
+    for tag, (ks, ls) in cases.items():
+        kb, kbit = blocked.block_positions(
+            ks, jnp.maximum(ls, 0), n_blocks=nb, block_bits=bb, k=k,
+            seed=cfg.seed, block_hash=cfg.block_hash,
+        )
+        got = sweep.apply_fat_query(
+            state, kb, kbit, ls >= 0, block_bits=bb, params=small,
+            interpret=True,
+        )
+        m = blocked.build_masks(kbit, w)
+        want = (jnp.all((state[kb] & m) == m, axis=-1)) & (ls >= 0)
+        assert bool((np.asarray(got) == np.asarray(want)).all()), (
+            f"query kernel verdicts diverge from the gather reference ({tag})"
+        )
+    return {
+        "north_star_query_path": path,
+        "north_star_query_geometry": list(params) if params else None,
+        "bit_exact_cases": sorted(cases),
+    }
+
+
+def _query_hammer(addr: str, name: str, threads: int, duration_s: float) -> float:
+    """Aggregate keys/sec of `threads` query CONNECTIONS (one client =
+    one channel each) probing a 50/50 present/absent key mix."""
+    from tpubloom.server.client import BloomClient
+
+    clients = [BloomClient(addr) for _ in range(threads)]
+    for c in clients:  # negotiate + warm the channel outside the window
+        c.include_batch(name, np.arange(BATCH, dtype=np.uint64))
+    stop = time.monotonic() + duration_s
+    counts = [0] * threads
+
+    def worker(t):
+        c = clients[t]
+        present = np.arange(BATCH // 2, dtype=np.uint64) + (
+            (t * 131) % (POPULATION // BATCH)
+        ) * BATCH
+        absent = np.arange(BATCH - BATCH // 2, dtype=np.uint64) + (1 << 50)
+        base = np.concatenate([present, absent])
+        i = 0
+        while time.monotonic() < stop:
+            c.include_batch(name, base + (i % 7))
+            counts[t] += BATCH
+            i += 1
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rate = sum(counts) / (time.perf_counter() - t0)
+    for c in clients:
+        c.close()
+    return rate
+
+
+def _prime(client, name: str) -> None:
+    """Create + populate the filter and compile every query jit bucket a
+    coalesced flush can produce (merged sizes pad to powers of two in
+    [BATCH, CONNECTIONS*BATCH]) — without this the window eats one XLA
+    compile per new shape and the gate measures compile time."""
+    client.create_filter(name, capacity=1_000_000, error_rate=0.01)
+    pop = np.arange(POPULATION, dtype=np.uint64)
+    for off in range(0, POPULATION, 8192):
+        client.insert_batch(name, pop[off: off + 8192])
+    size = BATCH
+    while size <= CONNECTIONS * BATCH:
+        client.include_batch(name, np.arange(size, dtype=np.uint64))
+        size *= 2
+
+
+def _counters(client) -> tuple:
+    c = client.stats()["counters"]
+    return (
+        c.get("ingest_query_flushes", 0),
+        c.get("ingest_requests_coalesced", 0),
+    )
+
+
+def _measure(addr_coal, addr_direct, name, duration_s, stats_client) -> dict:
+    direct = _query_hammer(addr_direct, name, CONNECTIONS, duration_s)
+    f0, r0 = _counters(stats_client)
+    coalesced = _query_hammer(addr_coal, name, CONNECTIONS, duration_s)
+    f1, r1 = _counters(stats_client)
+    return {
+        "per_request_keys_per_sec": round(direct),
+        "coalesced_keys_per_sec": round(coalesced),
+        "coalesced_vs_per_request": round(coalesced / direct, 3),
+        "query_flushes": f1 - f0,
+        "requests_per_flush": round((r1 - r0) / max(f1 - f0, 1), 2),
+    }
+
+
+def run_load(
+    duration_s: float = 2.0,
+    *,
+    coalesce_args: tuple = ("--coalesce-max-keys", "16384",
+                            "--coalesce-max-wait-us", "2000"),
+) -> dict:
+    import tempfile
+
+    from tpubloom.server.client import BloomClient
+
+    out: dict = {
+        "connections": CONNECTIONS, "batch": BATCH,
+        "duration_s": duration_s,
+    }
+    out.update(_kernel_path_checks())
+
+    tmpdir = tempfile.mkdtemp(prefix="tpubloom-query-load-")
+    procs: list = []
+    # this bench GATES a coalesced-vs-per-request margin; the CI chaos
+    # shard's armed lock tracker (TPUBLOOM_LOCK_CHECK=1, inherited by
+    # subprocesses) taxes the coalescer's queue-condition churn far more
+    # than the per-request path — a perf gate must not measure the
+    # debug tracker (multichip_load's lesson). Chaos/lock coverage for
+    # the query-flush path lives in tests/test_ingest.py.
+    drop = ("TPUBLOOM_LOCK_CHECK", "TPUBLOOM_LOCK_CHECK_DIR")
+    try:
+        cproc, caddr = ingest_load._spawn(
+            tmpdir, 0, list(coalesce_args), env_drop=drop
+        )
+        procs.append(cproc)
+        dproc, daddr = ingest_load._spawn(tmpdir, 1, [], env_drop=drop)
+        procs.append(dproc)
+        cboot = BloomClient(caddr)
+        cboot.wait_ready(timeout=180.0)
+        dboot = BloomClient(daddr)
+        dboot.wait_ready(timeout=180.0)
+        _prime(cboot, "q")
+        _prime(dboot, "q")
+        dboot.close()
+
+        out.update(_measure(caddr, daddr, "q", duration_s, cboot))
+        if (
+            out["coalesced_vs_per_request"] < GATE
+            or out["requests_per_flush"] <= 1.5
+        ):
+            # one re-measure with a doubled window before failing: on a
+            # small shared CI runner a scheduler hiccup inside a 2s
+            # window can flip the comparison with no code defect
+            out["remeasured"] = True
+            out.update(_measure(caddr, daddr, "q", duration_s * 2, cboot))
+        cboot.close()
+        assert out["coalesced_vs_per_request"] >= GATE, (
+            f"coalesced query aggregate ({out['coalesced_keys_per_sec']} "
+            f"keys/s over {CONNECTIONS} connections) is only "
+            f"{out['coalesced_vs_per_request']}x the per-request path "
+            f"({out['per_request_keys_per_sec']}) — query flushes must "
+            f"amortize per-request decode+launch (gate {GATE}x)"
+        )
+        assert out["requests_per_flush"] > 1.5, (
+            f"only {out['requests_per_flush']} requests/flush — the "
+            f"aggregate gate passed without actual query coalescing"
+        )
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(run_load()))

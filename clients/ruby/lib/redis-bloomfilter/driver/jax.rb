@@ -28,9 +28,16 @@ class Redis
     module Driver
       class Jax
         SERVICE = "tpubloom.BloomService".freeze
+        # The FULL unary surface of the tpubloom protocol — kept in
+        # lockstep with tpubloom/server/protocol.py METHODS by the
+        # `ruby-parity` check in `python -m tpubloom.analysis.lint`
+        # (every entry must also have a call site in this driver or the
+        # cluster driver; drift fails CI).
         METHODS = %w[
           Health CreateFilter DropFilter ListFilters
           InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint Wait
+          SlowlogGet SlowlogReset Promote ReplicaOf
+          ClusterSlots ClusterSetSlot MigrateSlot MigrateInstall
         ].freeze
 
         IDENTITY = proc { |bytes| bytes }
@@ -159,9 +166,15 @@ class Redis
         end
 
         def delete(key, min_replicas: nil)
+          delete_batch([key], min_replicas: min_replicas)
+        end
+
+        def delete_batch(keys, min_replicas: nil)
           rpc(
             "DeleteBatch",
-            durability({ "name" => @name, "keys" => [key.to_s] }, min_replicas)
+            durability(
+              { "name" => @name, "keys" => keys.map(&:to_s) }, min_replicas
+            )
           )
           true
         end
@@ -187,6 +200,50 @@ class Redis
 
         def checkpoint
           rpc("Checkpoint", "name" => @name, "wait" => true)["seq"]
+        end
+
+        # -- admin / observability surface (protocol parity — the same
+        # verbs the Python client exposes; ROADMAP item 6 asks the Ruby
+        # drivers to cover the whole METHODS registry) -----------------
+
+        def drop_filter(final_checkpoint: true)
+          rpc(
+            "DropFilter",
+            { "name" => @name, "final_checkpoint" => final_checkpoint }
+          )
+          true
+        end
+
+        def list_filters
+          rpc("ListFilters", {})["filters"]
+        end
+
+        # Redis SLOWLOG GET parity: slowest requests first, each with
+        # method/args/duration/rid + the per-phase breakdown.
+        def slowlog_get(n = nil)
+          req = n ? { "n" => n } : {}
+          rpc("SlowlogGet", req)["entries"]
+        end
+
+        def slowlog_reset
+          rpc("SlowlogReset", {})["cleared"]
+        end
+
+        # HA admin verbs (REPLICAOF NO ONE / REPLICAOF parity). Raw
+        # node-level operations: they act on the CONNECTED node, not on
+        # the logical filter, and are never auto-retried (a replayed
+        # promotion under a bumped epoch answers STALE_EPOCH).
+        def promote!(epoch: nil, repl_log_dir: nil)
+          req = {}
+          req["epoch"] = epoch if epoch
+          req["repl_log_dir"] = repl_log_dir if repl_log_dir
+          rpc("Promote", req, no_retry: true)
+        end
+
+        def replica_of!(primary, epoch: nil)
+          req = { "primary" => primary }
+          req["epoch"] = epoch if epoch
+          rpc("ReplicaOf", req, no_retry: true)
         end
 
         private

@@ -71,6 +71,40 @@ class Redis
           super(opts.merge(address: owner))
         end
 
+        # -- cluster admin surface (CLUSTER SETSLOT / live-migration
+        # parity with the Python client; part of the ruby-parity check
+        # in python -m tpubloom.analysis.lint) -------------------------
+
+        # The connected node's slot map ({enabled, epoch, ranges, ...}).
+        def cluster_slots
+          rpc("ClusterSlots", {}, no_retry: true)
+        end
+
+        # Admin verb: slot=/state=/addr= or the bulk
+        # assign=[[start, stop, addr], ...] + epoch= form.
+        def cluster_set_slot(req)
+          rpc("ClusterSetSlot", req, no_retry: true)
+        end
+
+        # Drive the live migration of `slot` from the connected node
+        # (its owner) to `target`; blocks until the handoff finalizes.
+        def migrate_slot(slot, target)
+          rpc(
+            "MigrateSlot", { "slot" => slot.to_i, "target" => target },
+            no_retry: true
+          )
+        end
+
+        # Resume probe of a migration target's import gate for one
+        # filter ({"have" => <source seq> | nil}) — the node→node
+        # MigrateInstall hop's read-only form, exposed for tooling.
+        def migrate_install_probe(name)
+          rpc(
+            "MigrateInstall", { "name" => name, "probe" => true },
+            no_retry: true
+          )
+        end
+
         private
 
         # The freshest ClusterSlots answer across the bootstrap nodes;

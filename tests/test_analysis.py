@@ -203,6 +203,33 @@ def test_orphan_protocol_method_flagged(tmp_path):
     assert any("golden" in m for m in missing)
 
 
+def test_ruby_parity_flags_uncovered_and_stale(tmp_path):
+    """ISSUE 12 satellite: a protocol method with no Ruby call site, one
+    missing from the Ruby METHODS registry, and a stale registry entry
+    must each produce exactly one finding; a covered method none."""
+    server = tmp_path / "tpubloom" / "server"
+    server.mkdir(parents=True)
+    (server / "protocol.py").write_text('METHODS = ("Ping", "Ghost")\n')
+    driver = tmp_path / L.RUBY_DRIVER_DIR
+    driver.mkdir(parents=True)
+    (driver / "jax.rb").write_text(
+        'METHODS = %w[Ping Stale].freeze\n'
+        'def ping; rpc("Ping", {}); end\n'
+    )
+    msgs = sorted(f.message for f in L.check_ruby_parity(str(tmp_path)))
+    assert len(msgs) == 3, msgs
+    assert sum("'Ghost'" in m and "call site" in m for m in msgs) == 1
+    assert sum("'Ghost'" in m and "registry" in m for m in msgs) == 1
+    assert sum("'Stale'" in m for m in msgs) == 1
+    assert not any("'Ping'" in m for m in msgs)
+
+
+def test_ruby_parity_clean_on_real_tree():
+    """The real drivers cover the real protocol — part of the clean-tree
+    acceptance gate (the analysis CI job runs the same check)."""
+    assert L.check_ruby_parity(REPO) == []
+
+
 # -- static lint: the suppression grammar --------------------------------------
 
 

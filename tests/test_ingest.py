@@ -977,3 +977,86 @@ def test_ingest_load_smoke():
     out = ingest_load.run_load(duration_s=1.5)
     assert out["aggregate_keys_per_sec"] > out["single_conn_keys_per_sec"]
     assert out["wait_barrier_observations"] < out["quorum_write_requests"]
+
+
+# -- delete/clear coalescing (ISSUE 12 satellite — the PR-10 seam) ------------
+
+
+def test_concurrent_deletes_coalesce_exactly_once(coalesced_server):
+    """N clients' deletes coalesce into shared delete-only flushes — one
+    launch + one merged log record per flush — and a counting filter
+    proves exactly-once: every key inserted twice and deleted once must
+    still be present, deleted twice must be gone."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter(
+            "dcnt", capacity=200_000, error_rate=0.01, counting=True
+        )
+        all_keys = [b"dk-%d-%d" % (t, j) for t in range(6) for j in range(40)]
+        admin.insert_batch("dcnt", all_keys)
+        admin.insert_batch("dcnt", all_keys)  # count 2 per key
+        f0 = admin.stats()["counters"].get("ingest_delete_flushes", 0)
+
+        def deleter(t):
+            def go():
+                with s.client() as c:
+                    keys = [b"dk-%d-%d" % (t, j) for j in range(40)]
+                    c.delete_batch("dcnt", keys)
+            return go
+
+        _threads([deleter(t) for t in range(6)])
+        counters = admin.stats()["counters"]
+        flushes = counters.get("ingest_delete_flushes", 0) - f0
+        assert flushes >= 1, "deletes never rode a delete-only flush"
+        # count 2 - 1 = 1: a double-applied (or lost) delete flips this
+        assert admin.include_batch("dcnt", all_keys).all(), (
+            "a coalesced delete applied more than once"
+        )
+        _threads([deleter(t) for t in range(6)])
+        gone = int(admin.include_batch("dcnt", all_keys).sum())
+        assert gone == 0, f"{gone} keys survived two delete rounds"
+
+
+def test_clear_coalesces_to_one_apply(coalesced_server):
+    """Concurrent Clears park and collapse to ONE clear + ONE log
+    append; the filter is empty afterwards and every caller gets ok."""
+    s = coalesced_server
+    with s.client() as admin:
+        admin.create_filter("clr", capacity=100_000, error_rate=0.01)
+        admin.insert_batch("clr", [b"c-%d" % i for i in range(128)])
+        c0 = admin.stats()["counters"].get("ingest_clear_flushes", 0)
+
+        def clearer():
+            with s.client() as c:
+                c.clear("clr")
+
+        _threads([clearer for _ in range(5)])
+        counters = admin.stats()["counters"]
+        flushes = counters.get("ingest_clear_flushes", 0) - c0
+        assert flushes >= 1, "clears never rode a clear-only flush"
+        assert not admin.include_batch(
+            "clr", [b"c-%d" % i for i in range(128)]
+        ).any()
+
+
+def test_coalesced_delete_replays_from_dedup(coalesced_server):
+    """A same-rid retry of a coalesced delete answers from the dedup
+    cache (deletes are decrements — a replay would double-apply)."""
+    s = coalesced_server
+    svc = s.service
+    with s.client() as admin:
+        admin.create_filter(
+            "ddup", capacity=100_000, error_rate=0.01, counting=True
+        )
+        keys = [b"rk-%d" % i for i in range(32)]
+        admin.insert_batch("ddup", keys)
+        req = {"name": "ddup", "keys": keys, "rid": "delete-rid-1"}
+        r1 = svc.DeleteBatch(dict(req))
+        assert r1["ok"]
+        hits0 = svc.metrics.snapshot()["counters"].get("delete_dedup_hits", 0)
+        r2 = svc.DeleteBatch(dict(req))  # same-rid replay
+        assert r2["ok"] and r2.get("n") == r1.get("n")
+        hits1 = svc.metrics.snapshot()["counters"].get("delete_dedup_hits", 0)
+        assert hits1 == hits0 + 1, "replayed delete must hit the dedup cache"
+        # count 1 - 1 = 0, and NOT -1 twice: keys simply absent now
+        assert not admin.include_batch("ddup", keys).any()

@@ -182,9 +182,11 @@ def test_slowlog_rpc_roundtrip_with_rids(server):
         assert e["method"] == method
         assert e["batch"] == (256 if method == "InsertBatch" else 2)
         assert e["duration_s"] > 0 and "keys[" in e["args"]
-        # phase breakdown rides along (decode is wire-level, kernel is
-        # the device pass the filter layer recorded)
-        assert {"decode", "host_prep", "kernel", "encode"} <= set(e["phases"])
+        # phase breakdown rides along (decode is wire-level, kernel /
+        # kernel_query is the device pass the filter layer recorded —
+        # the read path gets its own span since ISSUE 12)
+        kphase = "kernel" if method == "InsertBatch" else "kernel_query"
+        assert {"decode", "host_prep", kphase, "encode"} <= set(e["phases"])
         assert sum(e["phases"].values()) <= e["duration_s"] + 1e-6
 
     n_before = len(client.slowlog_get())

@@ -1,0 +1,294 @@
+"""ISSUE 12: the dedicated read-only query sweep kernel.
+
+Bit-parity of ``tpubloom.ops.sweep``'s query path against the XLA
+gather reference (interpret mode on CPU — real-Mosaic validation runs
+on hardware via benchmarks/adversarial.py, like every kernel in this
+family), across bb ∈ {256, 512}, duplicate-skew keys (the
+overflow→gather fallback), tail padding, fat + logical storage, and the
+packed ``keys_fixed`` input path; plus the ``query_path`` funnel, the
+launch-mix counters, the query kind of the geometry-probe machinery,
+and the tier-1 smoke over ``benchmarks/query_load.py``.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpubloom.config import FilterConfig
+from tpubloom.filter import BlockedBloomFilter, make_blocked_query_fn
+from tpubloom.obs import counters as obs_counters
+from tpubloom.ops import blocked, sweep
+
+NB, BB, K, B = 8192, 512, 7, 8192
+CFG = FilterConfig(m=NB * BB, k=K, key_len=16, block_bits=BB)
+W = CFG.words_per_block
+
+
+def _positions(cfg, keys_u8, lengths):
+    return blocked.block_positions(
+        keys_u8, jnp.maximum(lengths, 0),
+        n_blocks=cfg.n_blocks, block_bits=cfg.block_bits, k=cfg.k,
+        seed=cfg.seed, block_hash=cfg.block_hash,
+    )
+
+
+def _gather_ref(cfg, state, keys, lengths):
+    blk, bit = _positions(cfg, keys, lengths)
+    masks = blocked.build_masks(bit, cfg.words_per_block)
+    return jnp.all((state[blk] & masks) == masks, axis=-1) & (lengths >= 0)
+
+
+@pytest.fixture(scope="module")
+def populated():
+    """A half-populated filter + the batch that populated it."""
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 256, (B, 16), np.uint8))
+    lengths = jnp.full((B,), 16, jnp.int32)
+    blk, bit = _positions(CFG, keys, lengths)
+    masks = blocked.build_masks(bit, W)
+    state = blocked.blocked_insert(
+        jnp.zeros((NB, W), jnp.uint32), blk, masks, jnp.arange(B) < B // 2
+    )
+    return state, keys, lengths
+
+
+def test_query_params_selected_for_north_star():
+    """THE path-selection gate: the north-star serving shape must
+    resolve to the dedicated query kernel on a TPU backend (chooser
+    math is backend-independent; the probe no-ops off-TPU)."""
+    north = FilterConfig(m=1 << 32, k=7, key_len=16, block_bits=512)
+    assert sweep.resolve_query_path(north, 1 << 23, backend="tpu") == "sweep"
+    assert sweep.choose_fat_query_params(north.n_blocks, 1 << 23, 16) is not None
+    # off-TPU auto resolves to gather — the kernel only lowers on TPU
+    assert sweep.resolve_query_path(north, 1 << 23, backend="cpu") == "gather"
+    # forced paths pass through the funnel untouched
+    assert (
+        sweep.resolve_query_path(north.replace(query_path="gather"), 1 << 23)
+        == "gather"
+    )
+
+
+def test_query_lambda_exceeds_presence_lambda():
+    """The chooser's point (ISSUE 12): with the update/delta scoped-VMEM
+    buffers gone, query geometries run AT LEAST the lambda the fused
+    presence chooser picks at the same shape."""
+    north = FilterConfig(m=1 << 32, k=7, key_len=16, block_bits=512)
+    nb = north.n_blocks
+    q = sweep.choose_fat_query_params(nb, 1 << 23, 16)
+    p = sweep.choose_fat_params(nb, 1 << 23, 16, presence=True)
+    assert q is not None and p is not None
+    lam_q = (1 << 23) * q[1] // nb
+    lam_p = (1 << 23) * p[1] // nb
+    assert lam_q >= lam_p
+
+
+def test_sweep_query_matches_gather_bb512(populated):
+    state, keys, lengths = populated
+    qfn = sweep.make_sweep_query_fn(CFG, interpret=True)
+    got = qfn(state, keys, lengths)
+    ref = _gather_ref(CFG, state, keys, lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert int(np.asarray(got).sum()) == B // 2
+
+
+def test_sweep_query_matches_gather_bb256():
+    nb, bb = 16384, 256
+    cfg = FilterConfig(m=nb * bb, k=5, key_len=16, block_bits=bb)
+    w = cfg.words_per_block
+    assert sweep.choose_fat_query_params(nb, B, w) is not None
+    rng = np.random.default_rng(1)
+    keys = jnp.asarray(rng.integers(0, 256, (B, 16), np.uint8))
+    lengths = jnp.full((B,), 16, jnp.int32)
+    blk, bit = _positions(cfg, keys, lengths)
+    masks = blocked.build_masks(bit, w)
+    state = blocked.blocked_insert(
+        jnp.zeros((nb, w), jnp.uint32), blk, masks, jnp.arange(B) < B // 3
+    )
+    got = sweep.make_sweep_query_fn(cfg, interpret=True)(state, keys, lengths)
+    ref = _gather_ref(cfg, state, keys, lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_duplicate_skew_falls_back_bit_exact(populated):
+    """Window overflow (duplicate skew) must route the whole batch to
+    the gather branch and stay verdict-exact."""
+    state, _, lengths = populated
+    rng = np.random.default_rng(2)
+    dup = jnp.asarray(
+        np.tile(rng.integers(0, 256, (16, 16), np.uint8), (B // 16, 1))
+    )
+    got = sweep.make_sweep_query_fn(CFG, interpret=True)(state, dup, lengths)
+    ref = _gather_ref(CFG, state, dup, lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_tail_padding_reports_false(populated):
+    """The documented contract: padding is a TAIL suffix; padded entries
+    report False and valid entries keep unshifted verdicts."""
+    state, keys, lengths = populated
+    lp = lengths.at[B - 100:].set(-1)
+    got = sweep.make_sweep_query_fn(CFG, interpret=True)(state, keys, lp)
+    ref = _gather_ref(CFG, state, keys, lp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert not np.asarray(got)[B - 100:].any()
+
+
+def test_fat_storage_view_parity(populated):
+    state, keys, lengths = populated
+    fat = state.reshape(NB * W // 128, 128)
+    got = sweep.make_sweep_query_fn(CFG, interpret=True, storage_fat=True)(
+        fat, keys, lengths
+    )
+    ref = _gather_ref(CFG, state, keys, lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_query_is_read_only(populated):
+    """The kernel must never touch the array (no write-back, no
+    donation): the storage bytes are identical after a query."""
+    state, keys, lengths = populated
+    before = np.asarray(state).copy()
+    sweep.make_sweep_query_fn(CFG, interpret=True)(state, keys, lengths)
+    np.testing.assert_array_equal(np.asarray(state), before)
+
+
+def test_forced_sweep_small_batch_demotes_to_gather():
+    """A served filter sees arbitrary batch sizes: query_path='sweep'
+    FORCED must answer small batches (below the kernel's lambda floor)
+    via the gather, not error — found live by the PR-12 verify drive
+    (a 64-key include_batch through the server 500'd). The demotion is
+    visible in the launch-mix counters."""
+    cfg = FilterConfig(
+        m=NB * BB, k=K, key_len=16, block_bits=BB, query_path="sweep"
+    )
+    assert sweep.effective_query_path(cfg, 64) == "gather"
+    f = BlockedBloomFilter(cfg)
+    f.insert_batch([b"small-%d" % i for i in range(32)])
+    g0 = obs_counters.get("query_gather_launches")
+    assert f.include_batch([b"small-%d" % i for i in range(32)]).all()
+    assert obs_counters.get("query_gather_launches") == g0 + 1
+    # big batches still ride the kernel
+    assert sweep.effective_query_path(cfg, B) == "sweep"
+
+
+def test_forced_sweep_on_unsupported_shape_raises():
+    cfg = FilterConfig(
+        m=1 << 16, k=7, key_len=16, block_bits=512, query_path="sweep"
+    )
+    qfn = sweep.make_sweep_query_fn(cfg, interpret=True)
+    state = jnp.zeros((cfg.n_blocks, cfg.words_per_block), jnp.uint32)
+    keys = jnp.zeros((64, 16), jnp.uint8)
+    with pytest.raises(ValueError, match="query_path='gather'"):
+        qfn(state, keys, jnp.full((64,), 16, jnp.int32))
+
+
+def test_filter_include_paths_ride_query_kernel(populated):
+    """End-to-end through BlockedBloomFilter: query_path='sweep' forced
+    (interpret on CPU) — include_batch AND the packed keys_fixed path
+    (include_packed) answer identically to a gather-path twin, and the
+    launch-mix counters record the resolved path."""
+    cfg = FilterConfig(
+        m=NB * BB, k=K, key_len=16, block_bits=BB, query_path="sweep"
+    )
+    f_sweep = BlockedBloomFilter(cfg)
+    f_gather = BlockedBloomFilter(cfg.replace(query_path="gather"))
+    rng = np.random.default_rng(3)
+    population = [rng.bytes(8) for _ in range(4096)]
+    f_sweep.insert_batch(population)
+    f_gather.insert_batch(population)
+    probes = population[:1024] + [rng.bytes(8) for _ in range(1024)]
+    s0 = obs_counters.get("query_sweep_launches")
+    got = f_sweep.include_batch(probes)
+    assert obs_counters.get("query_sweep_launches") == s0 + 1
+    want = f_gather.include_batch(probes)
+    np.testing.assert_array_equal(got, want)
+    assert got[:1024].all()
+    # packed fixed-width input (the `fixed` wire encoding's server path)
+    rows = np.frombuffer(b"".join(probes), np.uint8).reshape(len(probes), 8)
+    got_p = f_sweep.include_packed(rows)
+    want_p = f_gather.include_packed(rows)
+    np.testing.assert_array_equal(got_p, want_p)
+    np.testing.assert_array_equal(got_p, got)
+    g0 = obs_counters.get("query_gather_launches")
+    f_gather.include_batch(probes[:64])
+    assert obs_counters.get("query_gather_launches") == g0 + 1
+
+
+def test_make_blocked_query_fn_routes_through_funnel(populated):
+    """The pure-fn layer: query_path='sweep' builds the kernel path,
+    'gather' the gather path — identical verdicts (what 'auto' switches
+    between at trace time)."""
+    state, keys, lengths = populated
+    got = make_blocked_query_fn(CFG.replace(query_path="sweep"))(
+        state, keys, lengths
+    )
+    want = make_blocked_query_fn(CFG.replace(query_path="gather"))(
+        state, keys, lengths
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_query_probe_rides_probe_and_disk_cache(monkeypatch, tmp_path):
+    """The query chooser entry reuses the PR-11 probe machinery: on an
+    unvalidated device kind every query geometry probe-compiles once,
+    persists ok=True, and a simulated second process start answers from
+    disk with zero compiles."""
+    monkeypatch.setenv("TPUBLOOM_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(sweep, "_probe_env", lambda: "Fake TPU v9")
+    calls = []
+    monkeypatch.setattr(
+        sweep, "_probe_compile",
+        lambda fn, *sds: (calls.append(getattr(fn, "func", fn).__name__),
+                          (True, None))[1],
+    )
+    saved = (
+        dict(sweep._GEOM_PROBE_CACHE),
+        dict(sweep._GEOM_DISK_CACHE),
+        set(sweep._GEOM_DISK_LOADED),
+    )
+    try:
+        sweep._GEOM_PROBE_CACHE.clear()
+        sweep._GEOM_DISK_CACHE.clear()
+        sweep._GEOM_DISK_LOADED.clear()
+        geom = sweep.choose_fat_query_params(1 << 17, 4096, 16)
+        assert geom is not None
+        assert calls and all(n == "fat_sweep_query" for n in calls), (
+            f"query probes must compile the QUERY kernel, saw {calls}"
+        )
+        first = len(calls)
+        sweep._GEOM_PROBE_CACHE.clear()
+        sweep._GEOM_DISK_CACHE.clear()
+        sweep._GEOM_DISK_LOADED.clear()
+        assert sweep.choose_fat_query_params(1 << 17, 4096, 16) == geom
+        assert len(calls) == first, "second start must answer from disk"
+    finally:
+        sweep._GEOM_PROBE_CACHE.clear()
+        sweep._GEOM_PROBE_CACHE.update(saved[0])
+        sweep._GEOM_DISK_CACHE.clear()
+        sweep._GEOM_DISK_CACHE.update(saved[1])
+        sweep._GEOM_DISK_LOADED.clear()
+        sweep._GEOM_DISK_LOADED.update(saved[2])
+
+
+# -- tier-1 smoke over the load gate ------------------------------------------
+
+
+def test_query_load_smoke():
+    """The ISSUE-12 acceptance bench: query kernel path selected for the
+    north-star shape + bit-exact vs the XLA reference + coalesced query
+    throughput >= the per-request path (asserted inside run_load)."""
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks"),
+    )
+    import query_load
+
+    out = query_load.run_load(duration_s=1.5)
+    assert out["north_star_query_path"] == "sweep"
+    assert out["coalesced_vs_per_request"] >= query_load.GATE
+    assert out["requests_per_flush"] > 1.5

@@ -462,3 +462,75 @@ def test_packed_path_fires_shard_fault_points(cfg8):
         assert f.include_packed(rows).all()
     finally:
         faults.reset()
+
+
+# -- ISSUE 12: query sweep kernel in the sharded path + per-device phases -----
+
+
+def test_query_sweep_path_in_shard_map():
+    """The read-only query sweep inside shard_map (spd == 1, interpret
+    mode on the fake mesh): verdicts identical to the gather twin —
+    every key queries its in-shard row on every device, unowned
+    verdicts masked by `owned` before the psum."""
+    from tpubloom.ops import sweep
+
+    cfg = FilterConfig(
+        m=1 << 22, k=7, key_len=16, block_bits=512, shards=8,
+        query_path="sweep",
+    )
+    assert sweep.choose_fat_query_params(
+        cfg.n_blocks_per_shard, 4096, cfg.words_per_block
+    ) is not None
+    f = ShardedBloomFilter(cfg)
+    g = ShardedBloomFilter(cfg.replace(query_path="gather"))
+    rng = np.random.default_rng(12)
+    population = [rng.bytes(16) for _ in range(4096)]
+    f.insert_batch(population)
+    g.insert_batch(population)
+    probes = population[:1024] + [rng.bytes(16) for _ in range(1024)]
+    got = f.include_batch(probes)
+    want = g.include_batch(probes)
+    np.testing.assert_array_equal(got, want)
+    assert got[:1024].all(), "inserted keys must all be found"
+
+
+def test_query_sweep_stays_off_multi_shard_devices():
+    """With several shards per device the unowned keys would pile onto
+    shard-row 0's windows — those geometries must keep the gather
+    (documented in make_sharded_blocked_query_fn), still correct."""
+    cfg = FilterConfig(
+        m=1 << 22, k=7, key_len=16, block_bits=512, shards=16,
+        query_path="sweep",
+    )
+    f = ShardedBloomFilter(cfg)  # 16 shards / 8 devices -> spd=2
+    keys = [b"spd2-%d" % i for i in range(512)]
+    f.insert_batch(keys)
+    assert f.include_batch(keys).all()
+
+
+def test_per_shard_kernel_phases_on_direct_path():
+    """ROADMAP 1(c): under an active request context the mesh launch's
+    single kernel span breaks into per-shard completion phases — one
+    `kernel_shard<i>` per device, monotone in i (the fences run
+    sequentially from one start point)."""
+    from tpubloom.obs import context as obs
+
+    cfg = FilterConfig(m=1 << 22, k=7, key_len=16, block_bits=512, shards=8)
+    f = ShardedBloomFilter(cfg)
+    keys = [b"phase-%d" % i for i in range(256)]
+    n_dev = int(f.mesh.devices.size)
+    with obs.request("InsertBatch") as ictx:
+        f.insert_batch(keys)
+    with obs.request("QueryBatch") as qctx:
+        assert f.include_batch(keys).all()
+    for ctx, kphase in ((ictx, "kernel"), (qctx, "kernel_query")):
+        spans = [
+            ctx.phases.get(f"kernel_shard{i}") for i in range(n_dev)
+        ]
+        assert all(s is not None for s in spans), (
+            f"missing per-shard phases: {sorted(ctx.phases)}"
+        )
+        assert spans == sorted(spans), "spans must be monotone in shard index"
+        assert kphase in ctx.phases
+    # no context, no per-shard bookkeeping (the library path stays lean)
+    f.include_batch(keys)

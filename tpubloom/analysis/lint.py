@@ -47,6 +47,14 @@ Checks
     methods are registered in the service behavior maps and golden-
     tested.
 
+``ruby-parity``
+    (tree mode) The Ruby drivers track the protocol too (ROADMAP item
+    6 — two Ruby drivers now): every ``protocol.METHODS`` entry appears
+    as a quoted call-site literal somewhere in ``clients/ruby``'s
+    driver files, the base driver's ``METHODS`` registry constant
+    matches the protocol list exactly (no drift in either direction),
+    and the registry lists nothing the protocol dropped.
+
 Suppressions
 ============
 
@@ -78,6 +86,7 @@ CHECKS = (
     "fault-registry",
     "metric-registry",
     "protocol-coverage",
+    "ruby-parity",
     "suppression-reason",
     "unknown-suppression",
     "unused-suppression",
@@ -636,6 +645,70 @@ def check_protocol_coverage(repo_root: str) -> list:
     return findings
 
 
+#: where the Ruby drivers live, relative to the repo root.
+RUBY_DRIVER_DIR = os.path.join(
+    "clients", "ruby", "lib", "redis-bloomfilter", "driver"
+)
+
+_RUBY_METHODS_RE = re.compile(r"METHODS\s*=\s*%w\[([^\]]*)\]")
+
+
+def check_ruby_parity(repo_root: str) -> list:
+    """Every ``protocol.METHODS`` entry covered by the Ruby drivers
+    (ISSUE 12 satellite, ROADMAP item 6): a quoted call-site literal in
+    the union of the driver files, plus registry/protocol set equality
+    for the base driver's ``METHODS`` constant — so protocol growth
+    that forgets the Ruby side fails CI the same way a missing Python
+    handler does."""
+    proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
+    decls = _parse_string_collection(proto_path, ("METHODS",))
+    methods = list(decls.get("METHODS", ()))
+    driver_dir = os.path.join(repo_root, RUBY_DRIVER_DIR)
+    findings: list = []
+    if not methods or not os.path.isdir(driver_dir):
+        return findings
+    sources: dict[str, str] = {}
+    for fn in sorted(os.listdir(driver_dir)):
+        if fn.endswith(".rb"):
+            path = os.path.join(driver_dir, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    sources[path] = f.read()
+            except OSError:
+                continue
+    if not sources:
+        return findings
+    all_src = "\n".join(sources.values())
+    # only the BASE driver carries the METHODS registry constant the
+    # equality check applies to (the cluster driver subclasses it)
+    base_path = os.path.join(driver_dir, "jax.rb")
+    base_registry = {
+        m
+        for block in _RUBY_METHODS_RE.findall(sources.get(base_path, ""))
+        for m in block.split()
+    }
+    for m in methods:
+        if f'"{m}"' not in all_src and f"'{m}'" not in all_src:
+            findings.append(Finding(
+                "ruby-parity", base_path, 0,
+                f"protocol method {m!r} has no call site in any Ruby "
+                f"driver (clients/ruby)",
+            ))
+        if base_registry and m not in base_registry:
+            findings.append(Finding(
+                "ruby-parity", base_path, 0,
+                f"protocol method {m!r} missing from the Ruby driver's "
+                f"METHODS registry",
+            ))
+    for extra in sorted(base_registry - set(methods)):
+        findings.append(Finding(
+            "ruby-parity", base_path, 0,
+            f"Ruby METHODS registry lists {extra!r}, which is not a "
+            f"protocol method — stale registry entry",
+        ))
+    return findings
+
+
 def iter_py_files(paths: Iterable[str]) -> list:
     out = []
     for p in paths:
@@ -682,6 +755,7 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
 
     if config.tree_checks:
         findings.extend(check_protocol_coverage(repo_root))
+        findings.extend(check_ruby_parity(repo_root))
         for point in sorted(config.known_fault_points - fault_literal_seen):
             findings.append(
                 Finding(

@@ -88,6 +88,12 @@ class FilterConfig:
         qualifies and the sorted-scatter XLA path otherwise; ``"sweep"``
         / ``"scatter"`` force one. Not part of the filter's identity —
         both paths produce bit-identical arrays.
+      query_path: blocked-membership implementation: ``"auto"`` (default)
+        picks the read-only Pallas query sweep on TPU when the shape
+        qualifies (``tpubloom.ops.sweep.choose_fat_query_params``) and
+        the row-gather XLA path otherwise; ``"sweep"`` / ``"gather"``
+        force one. Not part of the filter's identity — both paths
+        answer bit-identical verdicts (reads never change the array).
       block_hash: in-block position derivation for the blocked layout
         (part of the filter's identity). ``"chunk"`` (the default when it
         fits) slices each position from disjoint bit ranges of the
@@ -114,6 +120,7 @@ class FilterConfig:
     checkpoint_every: int = 0
     block_bits: int = 0
     insert_path: str = "auto"
+    query_path: str = "auto"
     block_hash: str = "auto"
 
     def __post_init__(self) -> None:
@@ -146,6 +153,10 @@ class FilterConfig:
         if self.insert_path not in ("auto", "sweep", "scatter"):
             raise ValueError(
                 f"insert_path must be auto/sweep/scatter, got {self.insert_path}"
+            )
+        if self.query_path not in ("auto", "sweep", "gather"):
+            raise ValueError(
+                f"query_path must be auto/sweep/gather, got {self.query_path}"
             )
         if self.block_bits:
             bb = self.block_bits

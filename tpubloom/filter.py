@@ -29,6 +29,7 @@ import numpy as np
 
 from tpubloom.config import FilterConfig
 from tpubloom.obs import context as obs
+from tpubloom.obs import counters as obs_counters
 from tpubloom.ops import bitops, blocked, counting, hashing
 from tpubloom.utils.packing import (
     pack_keys,
@@ -286,6 +287,13 @@ def make_blocked_test_insert_fn(config: FilterConfig, *, storage_fat: bool = Fal
 def make_blocked_query_fn(config: FilterConfig, *, storage_fat: bool = False):
     """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked membership.
 
+    ``config.query_path`` selects the implementation (ISSUE 12): the
+    read-only Pallas query sweep (``tpubloom.ops.sweep`` — sorted window
+    fetch + nibble-extraction presence test, no write-back, no donated
+    chain) or the row-gather XLA path. Both answer bit-identical
+    verdicts; "auto" decides per (backend, batch shape) at trace time
+    through :func:`tpubloom.ops.sweep.resolve_query_path`.
+
     With ``storage_fat`` the gather reads fat [NB/J, 128] rows directly
     (row = blk // J, lane group blk % J) — no reshape of the array."""
     nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
@@ -293,6 +301,15 @@ def make_blocked_query_fn(config: FilterConfig, *, storage_fat: bool = False):
     J = 128 // w if w and 128 % w == 0 else 1
 
     def query(blocks, keys_u8, lengths):
+        from tpubloom.ops import sweep
+
+        # effective (not just resolved) path: a forced "sweep" on a
+        # shape the kernel cannot take demotes to the gather here —
+        # served filters see arbitrary batch sizes
+        if sweep.effective_query_path(config, keys_u8.shape[0]) == "sweep":
+            return sweep.make_sweep_query_fn(config, storage_fat=storage_fat)(
+                blocks, keys_u8, lengths
+            )
         blk, bit = blocked.block_positions(
             keys_u8, jnp.maximum(lengths, 0),
             n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
@@ -397,12 +414,26 @@ class _FilterBase:
     def launch_query(self, staged):
         """Launch the membership kernel on a staged batch; returns
         ``(device hits, valid count)`` — the caller's ``np.asarray`` is
-        the fence + D2H."""
+        the fence + D2H. Query device work runs under its own
+        ``kernel_query`` phase (ISSUE 12) so the read path's device
+        time is separable from the write path's in every dashboard."""
         d_keys, d_lengths, B = staged
-        with obs.phase("kernel"):
+        self._query_launch_counter(d_keys.shape[0])
+        with obs.phase("kernel_query"):
             hits = self._query(self.words, d_keys, d_lengths)
         self.n_queried += B
         return hits, B
+
+    def _kernel_fence(self, handle) -> None:
+        """Completion fence for one launched kernel (under an active
+        request context). ShardedBloomFilter overrides it to record
+        per-shard device-completion phases (ROADMAP 1(c))."""
+        handle.block_until_ready()
+
+    def _query_launch_counter(self, padded_batch: int) -> None:
+        """Launch-mix hook (ISSUE 12): BlockedBloomFilter counts which
+        membership path each query launch resolves to. No-op for
+        layouts without a query-path split."""
 
     # fixed-width batch API (the `fixed` wire encoding's server path)
 
@@ -415,15 +446,15 @@ class _FilterBase:
             # same honesty fence as insert_batch: under an active
             # request the kernel phase must cover real device work
             with obs.phase("kernel"):
-                out.block_until_ready()
+                self._kernel_fence(out)
         return int(rows.shape[0])
 
     def include_packed(self, rows: np.ndarray) -> np.ndarray:
         """Membership for fixed-width pre-packed keys."""
         hits, B = self.launch_query(self.stage_batch(rows=rows))
         if obs.current() is not None:
-            with obs.phase("kernel"):
-                hits.block_until_ready()
+            with obs.phase("kernel_query"):
+                self._kernel_fence(hits)
         with obs.phase("d2h"):
             out = np.asarray(hits)
         return out[:B]
@@ -466,16 +497,17 @@ class _FilterBase:
                 # op lock + donation data dependence already serialize
                 # same-filter work, and the gRPC hop is transport-bound
                 # at ~1/50 of device rate (benchmarks grpc_path_r5)
-                self.words.block_until_ready()
+                self._kernel_fence(self.words)
         self.n_inserted += B
 
     def include_batch(self, keys: Sequence[bytes | str]) -> np.ndarray:
         keys_u8, lengths, B = self._pack_padded(keys)
         keys_u8, lengths = self._stage_batch(keys_u8, lengths)
-        with obs.phase("kernel"):
+        self._query_launch_counter(keys_u8.shape[0])
+        with obs.phase("kernel_query"):
             hits = self._query(self.words, keys_u8, lengths)
             if obs.current() is not None:
-                hits.block_until_ready()
+                self._kernel_fence(hits)
         with obs.phase("d2h"):
             out = np.asarray(hits)
         self.n_queried += B
@@ -653,11 +685,27 @@ class BlockedBloomFilter(_FilterBase):
         with obs.phase("kernel"):
             self.words, present = self._test_insert(self.words, keys_u8, lengths)
             if obs.current() is not None:
-                present.block_until_ready()
+                self._kernel_fence(present)
         with obs.phase("d2h"):
             out = np.asarray(present)
         self.n_inserted += B
         return out[:B]
+
+    def _query_launch_counter(self, padded_batch: int) -> None:
+        """Launch-mix counters (ISSUE 12): which membership path this
+        launch resolves to — the same deterministic funnel the traced
+        kernel used (``resolve_query_path`` is pure in (config, backend,
+        padded batch shape)), counted host-side because the decision is
+        made at trace time and invisible to per-launch instrumentation.
+        ``query_sweep_launches`` + ``query_gather_launches`` sum to all
+        blocked query launches; a nonzero gather count on a TPU host
+        says batches are falling off the query kernel's envelope."""
+        from tpubloom.ops import sweep
+
+        if sweep.effective_query_path(self.config, max(1, padded_batch)) == "sweep":
+            obs_counters.incr("query_sweep_launches")
+        else:
+            obs_counters.incr("query_gather_launches")
 
     @property
     def words_logical(self) -> np.ndarray:

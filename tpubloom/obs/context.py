@@ -12,9 +12,19 @@ server, ``bench.py``, and the slowlog):
 * ``decode``    — wire bytes -> request dict (msgpack)
 * ``host_prep`` — key packing + batch padding on the host
 * ``h2d``       — staging packed arrays onto the device
-* ``kernel``    — jitted device work (dispatch + completion fence)
+* ``kernel``    — jitted MUTATING device work (dispatch + completion
+  fence): inserts, deletes, fused test-and-insert
+* ``kernel_query`` — jitted READ-ONLY device work (membership queries)
+  — split from ``kernel`` since ISSUE 12 so the read path's device time
+  is trackable on its own (the query sweep kernel is the direct lever
+  on it)
 * ``d2h``       — device results -> host arrays
 * ``encode``    — response dict -> wire bytes
+
+Sharded filters additionally emit ``kernel_shard<i>`` spans on the
+direct (per-request) path: per-device time-to-completion of one mesh
+launch, measured from the fence start (ROADMAP 1(c) — the straggler
+shard is the widest span).
 
 Under JAX async dispatch the h2d/kernel boundary is approximate (the
 transfer may still be in flight when dispatch starts); the completion

@@ -59,11 +59,14 @@ COUNTERS = (
     "ha_demotions",
     "ha_promotions",
     "ha_role_transitions",
+    "ingest_clear_flushes",
+    "ingest_delete_flushes",
     "ingest_fallback_direct",
     "ingest_flushes",
     "ingest_fused_flushes",
     "ingest_keys_coalesced",
     "ingest_plain_flushes",
+    "ingest_query_flushes",
     "ingest_requests_coalesced",
     "ingest_split_flushes",
     "insert_dedup_hits",
@@ -72,6 +75,8 @@ COUNTERS = (
     "keys_queried",
     "log_failstop_rejected",
     "monitor_events_dropped",
+    "query_gather_launches",
+    "query_sweep_launches",
     "quorum_stale_acks",
     "quorum_write_failures",
     "quorum_writes_acked",

@@ -1152,7 +1152,7 @@ def _validated_kbjp_cap(kind_name: str, sig) -> int:
 
 def _fat_geometry_compiles(
     nb: int, w: int, geom, *, presence: bool, counting: bool,
-    batch: int | None = None,
+    query: bool = False, batch: int | None = None,
 ) -> bool:
     """True if the fat kernel at ``geom`` compiles on the current device.
 
@@ -1178,18 +1178,24 @@ def _fat_geometry_compiles(
     # chooser's volume bound and apply_fat_counter_updates use
     # fat_pack(w, presence) — probing a pack=1 counting kernel would
     # validate a strictly lighter scoped-VMEM footprint than the real
-    # PACK=4 unroll
-    pk = fat_pack(w, presence)
+    # PACK=4 unroll. The query kernel's stream carries the idx column
+    # like presence streams, so its pack matches presence's.
+    pk = fat_pack(w, presence or query)
     kbjp = _packed_rows(KBJ, pk)
     if any(v in kind for v in _VALIDATED_DEVICE_KINDS):
-        if not (presence or counting):
+        if not (presence or counting or query):
             return True
-        kname = "presence" if presence else "counting"
-        sig = (J, R8, S, _packed_rows(KJ, pk))
-        if sig in _VALIDATED_GEOMS[kname] and kbjp <= _validated_kbjp_cap(
-            kname, sig
-        ):
-            return True
+        if not query:
+            kname = "presence" if presence else "counting"
+            sig = (J, R8, S, _packed_rows(KJ, pk))
+            if sig in _VALIDATED_GEOMS[kname] and kbjp <= _validated_kbjp_cap(
+                kname, sig
+            ):
+                return True
+        # query geometries have NO hardware-validated signature set yet
+        # (ISSUE 12 ships the kernel; the first TPU round will grow one)
+        # — every query shape probe-compiles, on v5e too, and the result
+        # persists in the on-disk cache like any other probe.
     # update-stream rows exactly as _fat_stream will build them at
     # runtime; probes with no batch at hand keep the legacy stand-in
     if batch is None:
@@ -1198,7 +1204,7 @@ def _fat_geometry_compiles(
         upd_rows = int(batch) + KBJ + _ALIGN
     else:
         upd_rows = -(-int(batch) // pk) + kbjp + _ALIGN
-    key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting, upd_rows)
+    key = (kind, nb, w, J, R8, S, KJ, KBJ, presence, counting, query, upd_rows)
     hit = _GEOM_PROBE_CACHE.get(key)
     if hit is not None:
         return hit
@@ -1214,6 +1220,10 @@ def _fat_geometry_compiles(
         fn = functools.partial(
             fat_sweep_counter, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w,
             increment=True, pack=pk,
+        )
+    elif query:
+        fn = functools.partial(
+            fat_sweep_query, J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, pack=pk,
         )
     else:
         fn = functools.partial(
@@ -2379,3 +2389,533 @@ def make_sweep_insert_fn(
         return new_blocks, present
 
     return insert
+
+
+# =========================================================================
+# Read-only fat query sweep — the dedicated query kernel (ISSUE 12)
+# =========================================================================
+#
+# Why a query kernel at all: RESULTS_r5 §4 fenced every GATHER-based
+# query at ~60M keys/s (XLA's row gather serves one row per ~12.3 ns
+# regardless of locality) and measured the full gather query at 41.7M
+# (BENCH r05 query_only) — the read path is now the slow half of the
+# device-speed gap (insert-only runs 67.7M). §4 also argued a sweep
+# query "would be a wash" against the FUSED kernel's front-end — but
+# that arithmetic charged the query the fused kernel's whole budget.
+# RESULTS_r5 §2 proved the sweep family is per-window-OVERHEAD-bound,
+# not MXU-bound, and the fused kernel's window cost is dominated by the
+# machinery a pure query never needs:
+#
+# * no delta: the placement cnt matmul ([KJC, R8]^T @ [KJC, W*32] int8,
+#   the kernel's largest contraction), the bit-plane expansion of the
+#   update stream, and the plane->word pack matmuls all vanish;
+# * no write-back: blocks stream HBM->VMEM only (half the array DMA),
+#   there is no donated-blocks chain, and the output is just the
+#   presence tiles — so query steps need no buffer donation and can
+#   pipeline against a concurrent reader;
+# * no counter planes, no merge/representative selection.
+#
+# What remains per window is exactly the r5 extraction trick
+# (RESULTS_r5 §1): one placement one-hot, ONE [KJC, R8] @ [R8, 8W] int8
+# nibble-extraction matmul, the (mask & row) == mask VPU test, and the
+# slot-value pack — the lightest member of the sweep family. The
+# front-end (skey sort + stream build) and the unsort are shared with
+# the fused kernel and already floor-proofed stage by stage (§6b).
+#
+# Geometry: the scoped-VMEM update/delta buffers are gone, so query
+# tiles can run LARGER lambda than presence tiles at equal footprint
+# (choose_fat_query_params relaxes the scoped estimate accordingly).
+# There is no hardware-validated signature set yet — every geometry
+# probe-compiles through the PR-11 machinery (AOT, per-process cache +
+# per-device-kind persistent disk cache), so an unvalidated shape
+# demotes to the gather path instead of erroring at first use.
+# benchmarks/profile_query.py is the per-stage harness;
+# benchmarks/query_load.py asserts path selection + bit-exactness and
+# gates the served (coalesced) read path.
+
+
+def _fat_query_kernel(
+    starts_ref,  # SMEM [J * P8 + 1] i32 (scalar prefetch)
+    upd_ref,  # ANY [BtotP, 128]: PACK queries/row — skey, masks, idx+1
+    blocks_ref,  # VMEM [S * R8, 128] fat rows (auto-streamed, read-only)
+    pres_ref,  # VMEM [KJC, 128] presence tile for this grid step
+    sup_ref,  # VMEM scratch [2, J, KBJP, 128] u32
+    sems,  # DMA sems [2, J]
+    *,
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    P8: int,
+    W: int,
+    J: int,
+    NBJ: int,
+    PACK: int = 1,
+):
+    """Membership-only fat sweep: the :func:`_fat_kernel` presence half
+    with the whole update/delta machinery deleted. Same substream-sorted
+    stream layout (col 0 skey, 1..W mask words, W+1 idx+1), same
+    double-buffered window fetches, same slot-tile output consumed by
+    :func:`_fat_unsort_presence` — but ``blocks_ref`` is never written
+    (no ``input_output_aliases``, no donation) and the only output is
+    the presence tiles. Like the fat insert kernel there is NO in-kernel
+    chunk loop: window overflow (adversarial duplicate skew) is detected
+    host-side and the whole batch takes the gather fallback."""
+    p = pl.program_id(0)
+    num_p = pl.num_programs(0)
+    STRIDE = 128 // PACK
+    KJP = _packed_rows(KJ, PACK)
+    KBJP = _packed_rows(KBJ, PACK)
+
+    def a_big(j, pp):
+        return ((starts_ref[j * P8 + pp * S] // PACK) // _ALIGN) * _ALIGN
+
+    def fetch(slot, pp):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(a_big(j, pp), KBJP), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).start()
+
+    def wait(slot):
+        for j in range(J):
+            pltpu.make_async_copy(
+                upd_ref.at[pl.ds(0, KBJP), :],
+                sup_ref.at[slot, j],
+                sems.at[slot, j],
+            ).wait()
+
+    slot = lax.rem(p, 2)
+
+    @pl.when(p == 0)
+    def _():
+        fetch(0, 0)
+
+    @pl.when(p + 1 < num_p)
+    def _():
+        fetch(1 - slot, p + 1)
+
+    wait(slot)
+    # presence slots in a [KJC, 128] tile per grid step, slot (u, packed
+    # row r) of window (j, t) at row u*KJP + r, column t*J + j — the
+    # exact layout _fat_kernel emits, so the unsort is shared verbatim
+    pres_acc = jnp.zeros((PACK * KJP, 128), jnp.uint32)
+    colsR = lax.broadcasted_iota(jnp.int32, (KJP, R8), 1)
+    colpu = lax.broadcasted_iota(jnp.int32, (KJP, 128), 1)
+    iota_r = lax.broadcasted_iota(jnp.int32, (KJP, 1), 0)
+    for t in range(S):
+        sl = pl.ds(t * R8, R8)
+        tile = blocks_ref[sl, :]  # [R8, 128] fat rows (never written)
+        base_rf = (p * S + t) * R8
+        for j in range(J):
+            qi = j * P8 + p * S + t
+            skey0 = _u32(j * NBJ) + _u32(base_rf)
+            rel = ((starts_ref[qi] // PACK) // _ALIGN) * _ALIGN - a_big(j, p)
+            rel = jnp.clip(rel, 0, KBJP - KJP)
+            sub0 = sup_ref[slot, j, pl.ds(rel, KJP), :]  # [KJP, 128]
+            a0 = a_big(j, p) + rel  # packed-row units
+            end = starts_ref[qi + 1]
+            # per-slot COMPUTED one-hots concat along the contraction
+            # axis (raw lane slices cannot sublane-concat in Mosaic,
+            # computed values can — the _fat_kernel pattern)
+            ohs = []
+            for u in range(PACK):
+                base = u * STRIDE
+                rl = (sub0[:, base : base + 1] - skey0).astype(jnp.int32)
+                ohs.append(
+                    jnp.where(rl == colsR, jnp.float32(1), jnp.float32(0))
+                )
+            oh_f32 = (
+                jnp.concatenate(ohs, axis=0) if PACK > 1 else ohs[0]
+            )  # [KJC, R8]
+            # membership by OLD-ROW NIBBLE EXTRACTION (RESULTS_r5 §1):
+            # recover each slot's block row nibble-exact through the
+            # placement one-hot (int8 matmul, one-hot x values <= 15,
+            # i32 accumulation), then test (mask & row) == mask on the
+            # nibble planes. Slots whose row is outside this window
+            # extract row 0 garbage; `real` masks them below.
+            tj = tile[:, j * W : (j + 1) * W]  # [R8, W] u32
+            tn = jnp.concatenate(
+                [
+                    ((tj >> _u32(4 * n)) & _u32(15)).astype(jnp.int8)
+                    for n in range(8)
+                ],
+                axis=1,
+            )  # [R8, 8W] row nibbles
+            rn = lax.dot_general(
+                oh_f32.astype(jnp.int8), tn, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [KJC, 8W] per-slot row nibbles (one-hot-exact)
+            rn_u = rn.astype(jnp.uint32)
+            mns = []
+            for u in range(PACK):
+                mu = sub0[:, u * STRIDE + 1 : u * STRIDE + 1 + W]
+                mns.append(
+                    jnp.concatenate(
+                        [(mu >> _u32(4 * n)) & _u32(15) for n in range(8)],
+                        axis=1,
+                    )
+                )
+            mn = jnp.concatenate(mns, axis=0) if PACK > 1 else mns[0]
+            okf = jnp.where(
+                (mn & rn_u) == mn, jnp.float32(1), jnp.float32(0)
+            )
+            hit = jnp.min(okf, axis=1, keepdims=True)  # [KJC, 1] f32
+            vus = []
+            for u in range(PACK):
+                hit_u = lax.slice_in_dim(hit, u * KJP, (u + 1) * KJP, axis=0)
+                idxp1 = sub0[
+                    :, u * STRIDE + W + 1 : u * STRIDE + W + 2
+                ]  # [KJP, 1]
+                ipos = (a0 + iota_r) * PACK + u
+                real = (ipos >= starts_ref[qi]) & (ipos < end) & (idxp1 > 0)
+                hbit = jnp.where(hit_u > 0.5, _u32(0x80000000), _u32(0))
+                v = jnp.where(real, idxp1 | hbit, _u32(0))
+                vus.append(jnp.where(colpu == t * J + j, v, _u32(0)))
+            v128 = (
+                jnp.concatenate(vus, axis=0) if PACK > 1 else vus[0]
+            )  # [KJC, 128], u-major
+            pres_acc = pres_acc | v128
+    pres_ref[:] = pres_acc
+
+
+def fat_sweep_query(
+    blocks_fat: jnp.ndarray,
+    upd: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    J: int,
+    R8: int,
+    S: int,
+    KJ: int,
+    KBJ: int,
+    W: int,
+    interpret: bool = False,
+    pack: int = 1,
+) -> jnp.ndarray:
+    """Run the read-only query sweep over the fat block view.
+
+    Same stream contract as :func:`fat_sweep_insert` with presence
+    (col 0 skey, 1..W masks, W+1 original index + 1, sentinel tail
+    padding); returns ONLY the ``uint32[P*KJC, 128]`` presence slot
+    tiles (``idx+1 | hit << 31`` per slot — the
+    :func:`_fat_unsort_presence` layout). ``blocks_fat`` is read-only:
+    no aliasing, no donation — a query step never invalidates the
+    array a concurrent launch may also be reading."""
+    NB8, L = blocks_fat.shape
+    assert L == 128
+    P8 = NB8 // R8
+    P = P8 // S
+    kjc = pack * _packed_rows(KJ, pack)
+    kbjp = _packed_rows(KBJ, pack)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((S * R8, 128), lambda p, *_: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((kjc, 128), lambda p, *_: (p, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, J, kbjp, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, J)),
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(
+            _fat_query_kernel,
+            R8=R8, S=S, KJ=KJ, KBJ=KBJ, P8=P8, W=W, J=J, NBJ=NB8,
+            PACK=pack,
+        ),
+        out_shape=jax.ShapeDtypeStruct((P * kjc, 128), jnp.uint32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    return fn(starts, upd, blocks_fat)
+
+
+def choose_fat_query_params(nb: int, batch: int, words_per_block: int = 16):
+    """(J, R8, S, KJ, KBJ) for the read-only query sweep, or None.
+
+    The query chooser entry (ISSUE 12): windows run 6-sigma slack like
+    presence windows (overflow falls back to the gather query, which is
+    also the universal fallback path), lambda prefers the LARGEST
+    feasible value (the kernel is per-window-overhead-bound and a pure
+    query has even less per-window arithmetic to amortize than the
+    fused kernel — RESULTS_r5 §2/§2b), and the scoped-VMEM estimate
+    drops the fused kernel's output-tile and delta terms, which is what
+    lets query geometries run larger lambda at equal footprint. The
+    bodies/volume caps start at the presence kernel's measured envelope
+    (the query body is a strict subset of the presence body's scoped
+    surfaces, so every shape the presence caps admit is safe here);
+    shapes beyond it are admitted solely by the probe compile — ground
+    truth on hardware, cached per process and per device kind on disk
+    (the PR-11 machinery)."""
+    import math
+
+    w = words_per_block
+    if 1 + w + 1 > 128:
+        # stream row holds skey + W mask words + key idx in 128 lanes
+        return None
+    J = 128 // w
+    if J < 1 or w * J != 128 or nb % J:
+        return None
+    NBJ = nb // J
+    cap = 1024
+    candidates = []
+    for r8 in (32, 64, 128, 256, 512, 1024):
+        if r8 > NBJ or NBJ % r8:
+            continue
+        lam = batch * r8 // nb
+        if lam < 8:
+            # the sweep streams the WHOLE array per call — a sparse
+            # batch pays the full stream for a handful of rows (same
+            # break-even guard as the insert choosers)
+            continue
+        candidates.append((-lam, r8, lam))
+    for _, R8, lam in sorted(candidates):
+        kj_raw = max(
+            16, (lam + max(16, int(6 * math.sqrt(lam))) + 7) // 8 * 8
+        )
+        if kj_raw > 1024:
+            continue
+        KJ = kj_raw
+        P8 = NBJ // R8
+        for s in (8, 4, 2, 1):
+            if P8 % s or s * R8 > cap or P8 // s < 2:
+                continue
+            pk = fat_pack(w, True)  # stream carries the idx column
+            bodies = s * J * pk
+            # presence-kernel caps as the floor envelope (see docstring);
+            # the joint rule mirrors choose_fat_params' presence matrix
+            if bodies > 128:
+                continue
+            volume = bodies * _packed_rows(KJ, pk) * R8
+            cap_v = 3_500_000 if bodies <= 64 else 2_200_000
+            if volume > cap_v:
+                continue
+            kbj = ((lam * s + KJ + 64 + 7) // 8) * 8
+            sup_rows = _packed_rows(kbj, pk)
+            kjc = pk * _packed_rows(KJ, pk)
+            # scoped-VMEM estimate: double-buffered window fetches + the
+            # read-only block tile + the presence tile — the fused
+            # kernel's 4x (in+out tile) term shrinks to in-tile + pres
+            if (
+                2 * J * sup_rows * 128 * 4
+                + 2 * (s * R8 * 128 * 4)
+                + kjc * 128 * 4
+                <= 9 * 1024 * 1024
+            ):
+                geom = (J, R8, s, KJ, kbj)
+                if not _fat_geometry_compiles(
+                    nb, w, geom, presence=False, counting=False,
+                    query=True, batch=batch,
+                ):
+                    continue
+                return geom
+    return None
+
+
+def auto_query_path(
+    backend: str, n_blocks: int, batch: int, words_per_block: int = 16
+) -> str:
+    """The implementation ``query_path="auto"`` resolves to — the single
+    source of truth shared by :func:`tpubloom.filter.make_blocked_query_fn`,
+    the sharded per-device query loop, and the benchmarks' metadata. The
+    Mosaic kernel only lowers on TPU; every other backend takes the
+    gather path."""
+    if backend == "tpu" and choose_fat_query_params(
+        n_blocks, batch, words_per_block
+    ) is not None:
+        return "sweep"
+    return "gather"
+
+
+def resolve_query_path(
+    config, batch: int, backend: str | None = None, *,
+    n_blocks: int | None = None,
+) -> str:
+    """Resolve ``config.query_path`` ("auto"/"sweep"/"gather") for a
+    batch size on the current (or given) backend — the ONE funnel for
+    every blocked-membership path decision (single-chip, packed, and —
+    via ``n_blocks``, which the sharded per-device loop uses to pass
+    its LOCAL row count — the shard_map path)."""
+    qp = getattr(config, "query_path", "auto")
+    if qp != "auto":
+        return qp
+    if backend is None:
+        backend = jax.default_backend()
+    return auto_query_path(
+        backend,
+        config.n_blocks if n_blocks is None else n_blocks,
+        batch,
+        config.words_per_block,
+    )
+
+
+def effective_query_path(
+    config, batch: int, backend: str | None = None, *,
+    n_blocks: int | None = None,
+) -> str:
+    """:func:`resolve_query_path` with applicability folded in — what
+    actually LAUNCHES. A forced ``query_path="sweep"`` on a shape the
+    kernel cannot take (tiny batch below the lambda floor, odd
+    geometry, every candidate probe-demoted) answers "gather" instead
+    of erroring: queries are bit-identical on either path, so unlike a
+    forced insert sweep there is no silent-wrong-result risk a hard
+    error would protect against — and a served filter sees arbitrary
+    request sizes, where erroring on small batches would make the knob
+    unusable. The ``query_gather_launches`` counter reports the
+    demotion. Callers that want the raw kernel contract (tests, the
+    probes) use :func:`make_sweep_query_fn` directly, which still
+    raises on unsupported shapes."""
+    if backend is None:
+        backend = jax.default_backend()
+    return _effective_query_path_cached(
+        getattr(config, "query_path", "auto"),
+        config.n_blocks if n_blocks is None else n_blocks,
+        config.words_per_block,
+        batch,
+        backend,
+    )
+
+
+@functools.lru_cache(maxsize=512)
+def _effective_query_path_cached(
+    query_path: str, n_blocks: int, words_per_block: int, batch: int,
+    backend: str,
+) -> str:
+    """One chooser pass per distinct decision input, memoized — the
+    launch-mix counter calls this per query launch, and the chooser's
+    candidate scan (plus probe-cache lookups on TPU) is pure in these
+    five values for the life of the process (probe results only ever
+    warm monotonically, and the first chooser call settles them)."""
+    if query_path == "gather":
+        return "gather"
+    if query_path == "auto" and backend != "tpu":
+        return "gather"
+    if choose_fat_query_params(n_blocks, batch, words_per_block) is None:
+        return "gather"
+    return "sweep"
+
+
+def apply_fat_query(
+    blocks: jnp.ndarray,
+    blk: jnp.ndarray,
+    bit: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    block_bits: int,
+    params,
+    interpret: bool | None = None,
+    storage_fat: bool = False,
+) -> jnp.ndarray:
+    """Membership of each valid key via the read-only query sweep;
+    ``params`` from :func:`choose_fat_query_params`. Returns ``bool[B]``
+    (False at invalid entries). ``blocks`` is NEVER modified.
+
+    Contract (same as the fused presence path): invalid entries
+    (``valid`` False) must form a TAIL SUFFIX of the batch — they emit
+    no presence slot, so a mid-batch invalid entry would shift every
+    later key's verdict in the index-sorted unsort.
+    ``tpubloom.filter._pack_padded`` guarantees tail padding; the
+    sharded per-device loop passes ``lengths >= 0`` (NOT ``owned``) for
+    exactly this reason and masks unowned verdicts after the psum.
+
+    Windows that overflow their KJ fetch (adversarial duplicate skew)
+    route the WHOLE batch to the gather query under ``lax.cond`` — the
+    same correctness-safe fallback design as :func:`apply_fat_updates`.
+    """
+    w = block_bits // 32
+    J0, R8, S, KJ, KBJ = params
+    nb = blocks.size // w
+    B = blk.shape[0]
+    J = J0
+    NBJ = nb // J
+    P8 = NBJ // R8
+    interp = jax.default_backend() == "cpu" if interpret is None else interpret
+    blkv = jnp.where(valid, blk, nb)
+    j_of = (blkv % J).astype(jnp.uint32)
+    rf_of = (blkv // J).astype(jnp.uint32)
+    skey = jnp.where(valid, j_of * NBJ + rf_of, _u32(J * NBJ))
+    cols, nbits, packed = _pack_positions(bit, block_bits, bit.shape[-1])
+    idx0 = jnp.arange(1, B + 1, dtype=jnp.uint32)  # 0 = empty slot
+    sorted_cols = lax.sort((skey,) + cols + (idx0,), num_keys=1)
+    ss = sorted_cols[0]
+    bit_sorted = _unpack_positions(
+        sorted_cols[1:-1], block_bits, bit.shape[-1], nbits, packed
+    )
+    masks = blocked.build_masks(bit_sorted, w)
+    idx_sorted = sorted_cols[-1]
+    pack = fat_pack(w, True)
+    upd, starts = _fat_stream(
+        ss, masks, idx_sorted, J=J, NBJ=NBJ, P8=P8, R8=R8, KBJ=KBJ, W=w,
+        pack=pack,
+    )
+    overflow = _fat_window_overflow(
+        starts, J=J, P8=P8, S=S, KJ=KJ, KBJ=KBJ, pack=pack
+    )
+
+    def sweep_branch(ops):
+        bl, u, st = ops
+        presb = fat_sweep_query(
+            bl if storage_fat else bl.reshape(NBJ, 128), u, st,
+            J=J, R8=R8, S=S, KJ=KJ, KBJ=KBJ, W=w, interpret=interp,
+            pack=pack,
+        )
+        return _fat_unsort_presence(
+            presb, st, B, J=J, NBJ=NBJ, P8=P8, R8=R8, S=S,
+            KJ=pack * _packed_rows(KJ, pack), KBJ=KBJ,
+        )
+
+    def gather_branch(ops):
+        bl, u, st = ops
+        masks_orig = blocked.build_masks(bit, w)
+        if storage_fat:
+            hit = blocked.fat_blocked_query(bl, blk, masks_orig)
+        else:
+            rows = bl[jnp.minimum(jnp.where(valid, blk, 0), nb - 1)]
+            hit = jnp.all((rows & masks_orig) == masks_orig, axis=-1)
+        return hit & valid
+
+    return lax.cond(overflow, gather_branch, sweep_branch, (blocks, upd, starts))
+
+
+def make_sweep_query_fn(
+    config, *, interpret: bool | None = None, storage_fat: bool = False,
+):
+    """Pure ``(blocks, keys_u8, lengths) -> bool[B]`` blocked membership
+    via the read-only query sweep. Bit-identical verdicts to
+    :func:`tpubloom.filter.make_blocked_query_fn`'s gather path (same
+    blocked position spec; the CPU oracle is the shared ground truth).
+
+    ``storage_fat``: blocks are the fat [NB/J, 128] view (the
+    persistent-filter layout — no reshape at the kernel boundary).
+    Requires batch padding (lengths < 0) at the TAIL of the batch
+    (tpubloom.filter._pack_padded guarantees this); padded entries
+    return False.
+    """
+    nb, bb, w = config.n_blocks, config.block_bits, config.words_per_block
+    k, seed, bh = config.k, config.seed, config.block_hash
+
+    def query(blocks, keys_u8, lengths):
+        B = keys_u8.shape[0]
+        params = choose_fat_query_params(nb, B, w)
+        if params is None:
+            raise ValueError(
+                f"sweep query does not support this shape (n_blocks={nb}, "
+                f"batch={B}, words_per_block={w}) — use query_path='gather'"
+            )
+        valid = lengths >= 0
+        blk, bit = blocked.block_positions(
+            keys_u8, jnp.maximum(lengths, 0),
+            n_blocks=nb, block_bits=bb, k=k, seed=seed, block_hash=bh,
+        )
+        return apply_fat_query(
+            blocks, blk, bit, valid,
+            block_bits=bb, params=params, interpret=interpret,
+            storage_fat=storage_fat,
+        )
+
+    return query

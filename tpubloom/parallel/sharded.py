@@ -309,14 +309,49 @@ def make_sharded_blocked_insert_fn(config: FilterConfig, mesh: Mesh):
 
 def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
     """Blocked-layout sharded membership with the same psum-OR assembly as
-    the flat path: owners answer, ICI all-reduce merges."""
+    the flat path: owners answer, ICI all-reduce merges.
+
+    On TPU the per-device verdicts ride the read-only query sweep kernel
+    (ISSUE 12) when the LOCAL shape qualifies and ``shards_per_dev == 1``:
+    every key (owned or not) then queries its natural in-shard block row
+    on every device — the occupancy stays uniform over the local rows,
+    the sweep's tail-suffix presence contract holds (``lengths >= 0`` is
+    tail padding), and unowned keys' garbage verdicts are masked by
+    ``owned`` before the psum, exactly as the gather path masks them.
+    With several shards per device the unowned keys would pile onto
+    shard-row 0's windows (n_dev× the sized occupancy → perpetual
+    overflow fallback), so those geometries keep the gather."""
     shards_per_dev = config.shards // mesh.devices.size
+    local_rows = shards_per_dev * config.n_blocks_per_shard
 
     fat_store = local_blocked_storage_fat(config)
     w = config.words_per_block
 
     def local_query(blocks_block, keys_u8, lengths):
-        blk, masks, owned = _routed_blocks(config, shards_per_dev, keys_u8, lengths)
+        from tpubloom.ops import sweep
+
+        B = keys_u8.shape[0]
+        blk, masks, owned, bit = _routed_blocks(
+            config, shards_per_dev, keys_u8, lengths, want_bit=True
+        )
+        if fat_store and shards_per_dev == 1 and (
+            sweep.resolve_query_path(config, B, n_blocks=local_rows)
+            == "sweep"
+        ):
+            # window sizing uses the FULL batch: with spd == 1 every key
+            # lands at its in-shard row on every device (blk is already
+            # local — `owned` adds 0), so per-window occupancy covers B,
+            # same as the gather path's B-row gather per device
+            params = sweep.choose_fat_query_params(local_rows, B, w)
+            if params is not None:
+                flat = blocks_block.reshape(-1, 128)
+                verdict = sweep.apply_fat_query(
+                    flat, blk, bit, lengths >= 0,
+                    block_bits=config.block_bits, params=params,
+                    storage_fat=True,
+                )
+                one_hot = jnp.where(owned, verdict, False).astype(jnp.uint32)
+                return jax.lax.psum(one_hot, AXIS) > 0
         if fat_store:
             flat = blocks_block.reshape(-1, 128)
             verdict = blocked.fat_blocked_query(flat, blk, masks)
@@ -332,6 +367,9 @@ def make_sharded_blocked_query_fn(config: FilterConfig, mesh: Mesh):
         mesh=mesh,
         in_specs=(P(AXIS, None, None), P(), P()),
         out_specs=P(),
+        # pallas_call outputs carry no vma metadata (see blocked insert);
+        # the psum still assembles the replicated verdict either way
+        check_vma=False,
     )
 
 
@@ -671,6 +709,34 @@ class ShardedBloomFilter(_FilterBase):
     def include_batch(self, keys):
         self._fire_shard_faults("shard.query", keys)
         return super().include_batch(keys)
+
+    # -- per-device phase metrics (ISSUE 12 satellite, ROADMAP 1(c)) ---------
+
+    def _kernel_fence(self, handle) -> None:
+        """Break the single ``kernel``/``kernel_query`` span into
+        per-shard device timings on the direct (per-request) path: fence
+        each addressable shard in turn, recording a ``kernel_shard<i>``
+        phase measured from the fence start — shard i's span is the
+        time by which shards 0..i had all completed (the fences run
+        sequentially over concurrently-executing devices), so the spans
+        are monotone and the first big JUMP names the straggler device.
+        Runs ONLY under an active request
+        context (the library/bench paths keep the single fence;
+        coalesced flushes fence on the dispatcher, which carries no
+        request context — the per-flush span stays whole there, as
+        before)."""
+        import time
+
+        ctx = obs.current()
+        shards = getattr(handle, "addressable_shards", None)
+        if ctx is None or not shards or len(shards) <= 1:
+            handle.block_until_ready()
+            return
+        t0 = time.perf_counter()
+        for i, sh in enumerate(shards):
+            sh.data.block_until_ready()
+            ctx.add_phase(f"kernel_shard{i}", time.perf_counter() - t0)
+        handle.block_until_ready()
 
     # -- staged / packed surface (ISSUE 11) ----------------------------------
     #

@@ -4,7 +4,8 @@ The device sweeps tens of millions of keys per second, but the host
 front-end feeds it one gRPC request at a time: per-request decode, lock,
 jit dispatch and — under synchronous replication — one commit barrier
 per write. This module closes that gap with the Redis-pipelining move
-applied server-side: concurrent ``InsertBatch``/``QueryBatch`` RPCs
+applied server-side: concurrent ``InsertBatch``/``QueryBatch``/
+``DeleteBatch``/``Clear`` RPCs (deletes and clears since ISSUE 12)
 **park** in a bounded per-(filter, op) coalescing queue, a single
 dispatcher thread flushes each queue on size/bytes/deadline
 (``--coalesce-max-keys`` / ``--coalesce-max-wait-us``), runs the fused
@@ -231,6 +232,16 @@ class IngestCoalescer:
 
     # -- producer side -------------------------------------------------------
 
+    #: method -> per-filter queue kind: each kind flushes as its own
+    #: op-pure launch + merged log record (queries/inserts since ISSUE
+    #: 10; deletes and clears since ISSUE 12 — the named PR-10 seam)
+    _KINDS = {
+        "InsertBatch": "insert",
+        "QueryBatch": "query",
+        "DeleteBatch": "delete",
+        "Clear": "clear",
+    }
+
     def submit(self, method: str, req: dict, *,
                replay_unsafe: bool = False) -> Optional[dict]:
         """Park one request until its flush completes; returns the
@@ -241,13 +252,17 @@ class IngestCoalescer:
 
         faults.fire("ingest.coalesce")
         rows = keys = None
+        kind = self._KINDS[method]
         fx = protocol.fixed_keys(req)
         if fx is not None:
             data, width, n = fx
             rows = np.frombuffer(data, np.uint8).reshape(n, width)
         else:
-            keys = req["keys"]
-        kind = "query" if method == "QueryBatch" else "insert"
+            # Clear carries no keys — it parks as an empty entry and the
+            # flush applies ONE clear for the whole parked run
+            keys = req.get("keys") if kind != "clear" else []
+            if keys is None:
+                keys = []
         entry = _Entry(req, rows=rows, keys=keys, replay_unsafe=replay_unsafe)
         name = req["name"]
         with self._cond:
@@ -393,7 +408,16 @@ class IngestCoalescer:
         total_keys = sum(e.nkeys for e in entries)
         service.metrics.count("ingest_keys_coalesced", total_keys)
         if kind == "query":
+            service.metrics.count("ingest_query_flushes")
             self._flush_query(mf, entries)
+            return
+        if kind == "delete":
+            service.metrics.count("ingest_delete_flushes")
+            self._flush_delete(name, mf, entries)
+            return
+        if kind == "clear":
+            service.metrics.count("ingest_clear_flushes")
+            self._flush_clear(name, mf, entries)
             return
         # op-sorted flushes (ISSUE 11 satellite): ONE presence-wanting
         # request used to drag every flush-mate through the fused
@@ -579,6 +603,75 @@ class IngestCoalescer:
         else:
             self._settle(payload, None)
 
+    def _flush_delete(self, name: str, mf, entries: list) -> None:
+        """Delete-only flush (ISSUE 12 satellite — the PR-10 seam): ONE
+        ``delete_batch`` launch over the merged keys + ONE op-log append
+        + ONE commit barrier, demuxed per request exactly like inserts.
+        Deletes are always replay-unsafe (a replayed decrement double-
+        applies), so every entry's demuxed response is dedup-cached
+        under its rid by the shared finalize."""
+        service = self._service
+        rows, keys = self._demote_wide_rows(mf, *self._merge(entries))
+        # fence + settle any in-flight insert flush BEFORE the (donating)
+        # delete launch consumes its output buffer — a real kernel error
+        # must fail the INSERT's waiters, not surface as this delete's
+        self._settle(*self._inflight.take())
+        with mf.lock:
+            if service.cluster is not None and (
+                service.cluster.forward_target(name) is not None
+            ):
+                # dual-write window: per-request seqs keep the target's
+                # exactly-once gate sound — same fallback as inserts
+                fallback = True
+            else:
+                fallback = False
+                klist = keys if keys is not None else _rows_to_list(rows)
+                mf.filter.delete_batch(klist)
+                logged: dict = {"name": name}
+                if rows is not None:
+                    logged["keys_fixed"] = {
+                        "data": rows.tobytes(),
+                        "width": int(rows.shape[1]),
+                        "n": int(rows.shape[0]),
+                    }
+                else:
+                    logged["keys"] = keys
+                seq = service._log_op("DeleteBatch", logged, mf)
+        if fallback:
+            self._fallback_direct(entries, method="DeleteBatch")
+            return
+        service.metrics.count("keys_deleted", sum(e.nkeys for e in entries))
+
+        def finalize():
+            self._finalize_insert(entries, seq, None)
+
+        self._settle((entries, finalize, self._needs_barrier(entries, seq)), None)
+
+    def _flush_clear(self, name: str, mf, entries: list) -> None:
+        """Clear-only flush: the whole parked run collapses to ONE
+        ``clear()`` + ONE op-log append + ONE barrier (clears are
+        idempotent, so N concurrent clears ARE one clear — no dedup
+        caching needed and no per-request payload to demux)."""
+        service = self._service
+        self._settle(*self._inflight.take())  # see _flush_delete
+        with mf.lock:
+            if service.cluster is not None and (
+                service.cluster.forward_target(name) is not None
+            ):
+                fallback = True
+            else:
+                fallback = False
+                mf.filter.clear()
+                seq = service._log_op("Clear", {"name": name}, mf)
+        if fallback:
+            self._fallback_direct(entries, method="Clear")
+            return
+
+        def finalize():
+            self._finalize_insert(entries, seq, None)
+
+        self._settle((entries, finalize, self._needs_barrier(entries, seq)), None)
+
     def _needs_barrier(self, entries, seq) -> bool:
         if seq is None:
             return False
@@ -748,7 +841,7 @@ class IngestCoalescer:
             )
             return acked, e
 
-    def _fallback_direct(self, entries: list) -> None:
+    def _fallback_direct(self, entries: list, method: str = "InsertBatch") -> None:
         """Migration-window fallback: re-drive each parked request
         through the ordinary handler + its OWN barrier and dual-write
         forward — per-request seqs keep the target's exactly-once gate
@@ -758,14 +851,15 @@ class IngestCoalescer:
         from tpubloom.server import protocol
 
         service = self._service
+        handler = getattr(service, method)
         service.metrics.count("ingest_fallback_direct", len(entries))
         for entry in entries:
             try:
-                resp = service.InsertBatch(entry.req)
+                resp = handler(entry.req)
                 if resp.get("ok"):
                     resp = service.commit_barrier(entry.req, resp)
                     resp = cluster_migrate.forward_op(
-                        service, "InsertBatch", entry.req, resp
+                        service, method, entry.req, resp
                     )
                 resp = dict(resp)
                 resp["_coalesced"] = True

@@ -1547,12 +1547,13 @@ class BloomService:
             and rows.shape[1] <= getattr(mf.filter.config, "key_len", 0)
         )
 
-    def _coalesce_eligible(self, req: dict) -> bool:
+    def _coalesce_eligible(self, req: dict, method: str = "InsertBatch") -> bool:
         """Whether this request may park in the ingestion coalescer.
         Excluded: replay/stream-apply (exactly-once is seq-gated per
         RECORD there), the dispatcher's own fallback re-drives, and
         migration forwards (``asking``/``src_seq`` must hit the import
-        gate per-request)."""
+        gate per-request). ``Clear`` carries no key payload and is
+        eligible bare (ISSUE 12: delete/clear coalesce too)."""
         c = self._coalescer
         if c is None or not c.running or c.in_dispatcher():
             return False
@@ -1560,9 +1561,9 @@ class BloomService:
             return False
         if req.get("asking") or req.get("src_seq") is not None:
             return False
-        if not isinstance(req.get("keys"), list) and not isinstance(
-            req.get("keys_fixed"), dict
-        ):
+        if method != "Clear" and not isinstance(
+            req.get("keys"), list
+        ) and not isinstance(req.get("keys_fixed"), dict):
             return False
         return True
 
@@ -1707,6 +1708,16 @@ class BloomService:
         if cached is not None:
             self.metrics.count("delete_dedup_hits")
             return cached
+        if self._coalesce_eligible(req, "DeleteBatch"):
+            # ISSUE 12: delete-only flushes ride the scheduler — one
+            # launch + one merged log record + one barrier per flush;
+            # deletes are always replay-unsafe (decrements), so every
+            # demuxed response is dedup-cached under its rid
+            resp = self._coalescer.submit(
+                "DeleteBatch", req, replay_unsafe=True
+            )
+            if resp is not None:
+                return resp
         nkeys = protocol.batch_size(req)
         with mf.lock:
             mf.filter.delete_batch(self._keys_list(req))
@@ -1724,6 +1735,10 @@ class BloomService:
 
     def Clear(self, req: dict) -> dict:
         mf = self._get(req["name"])
+        if self._coalesce_eligible(req, "Clear"):
+            resp = self._coalescer.submit("Clear", req)
+            if resp is not None:
+                return resp
         with mf.lock:
             mf.filter.clear()
             seq = self._log_op("Clear", {"name": req["name"]}, mf)

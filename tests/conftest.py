@@ -57,9 +57,32 @@ def lock_check_armed(tmp_path_factory):
     every subprocess report — a new lock-order cycle or a blocking call
     under a registry/filter lock anywhere in the chaos run fails the
     module, which is the ISSUE-6 acceptance gate."""
+    from pathlib import Path
+
     from tpubloom.utils import locks
 
-    report_dir = tmp_path_factory.mktemp("lockcheck")
+    # ISSUE 13: when the environment already names a report dir (the CI
+    # chaos shard sets one so the reports survive as artifacts and the
+    # analysis job replays them through `python -m tpubloom.analysis`),
+    # keep collecting there instead of a throwaway tmp dir. All armed
+    # modules then share one dir — each teardown re-diffs earlier
+    # modules' (clean) reports, which is harmless and makes the gate
+    # fleet-wide rather than per-module.
+    preset = os.environ.get(locks.REPORT_DIR_ENV)
+    if preset:
+        report_dir = Path(preset)
+        report_dir.mkdir(parents=True, exist_ok=True)
+        # stale reports from an EARLIER pytest run (a developer's
+        # exported env var, a reused runner) would be re-diffed against
+        # today's manifest and fail a clean tree — clear them ONCE per
+        # process, so the armed modules of THIS run still accumulate
+        # into the shared dir for the CI artifact
+        if not getattr(lock_check_armed, "_preset_cleared", False):
+            lock_check_armed._preset_cleared = True
+            for stale in report_dir.glob("lockcheck-*.json"):
+                stale.unlink()
+    else:
+        report_dir = tmp_path_factory.mktemp("lockcheck")
     saved = {
         k: os.environ.get(k) for k in (locks.ENV_VAR, locks.REPORT_DIR_ENV)
     }
@@ -87,4 +110,42 @@ def lock_check_armed(tmp_path_factory):
             f"{v['message']} @ {v['site']}"
             for v in vios
         )
+    )
+
+
+@pytest.fixture(scope="module")
+def lock_order_manifest(lock_check_armed):
+    """ISSUE 13: the lock-ORDER closure gate, shared by every armed
+    chaos module (faults/ha/sync_repl joined cluster/ingest this PR).
+    After the whole armed module ran, every acquisition edge in the
+    runtime graph — the in-process tracker AND the subprocess exit
+    reports — must be DECLARED in the lock-order manifest
+    (``tpubloom/analysis/lock_order.py``). An undeclared edge anywhere
+    in the armed fleet is a test failure: new lock nesting is a
+    reviewed design decision, not an accident discovered at 3am.
+
+    Depends on ``lock_check_armed`` so this teardown runs FIRST (while
+    the tracker is still armed and the report dir env var still
+    points at this module's collected subprocess reports)."""
+    import glob
+
+    from tpubloom.analysis import lock_order
+    from tpubloom.utils import locks
+
+    yield
+    findings = lock_order.check_live()
+    report_dir = os.environ.get(locks.REPORT_DIR_ENV, "")
+    if report_dir and os.path.isdir(report_dir):
+        for path in sorted(
+            glob.glob(os.path.join(report_dir, "lockcheck-*.json"))
+        ):
+            with open(path) as f:
+                findings.extend(
+                    {**v, "report": os.path.basename(path)}
+                    for v in lock_order.check_report(json.load(f))
+                )
+    assert not findings, (
+        "undeclared lock-order edges (declare deliberately in "
+        "tpubloom/analysis/lock_order.py or fix the nesting):\n"
+        + "\n".join(f"  {f['message']}" for f in findings)
     )

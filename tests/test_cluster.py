@@ -47,8 +47,10 @@ from tpubloom.server.protocol import BloomServiceError
 from tpubloom.server.service import BloomService, build_server
 
 # ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
-# module (asserted violation-free at teardown — tests/conftest.py).
-pytestmark = pytest.mark.usefixtures("lock_check_armed")
+# module (asserted violation-free at teardown — tests/conftest.py),
+# plus the shared lock-ORDER manifest gate (ISSUE 13 moved the local
+# fixture into conftest so every armed chaos module runs the same diff).
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
 
 
 @pytest.fixture(autouse=True)
@@ -56,37 +58,6 @@ def _disarm_all():
     faults.reset()
     yield
     faults.reset()
-
-
-@pytest.fixture(scope="module", autouse=True)
-def lock_order_manifest(lock_check_armed):
-    """ISSUE 9 satellite (ROADMAP item 7): after the whole armed module
-    ran, every acquisition edge in the runtime graph — in-process AND
-    the subprocess exit reports — must be DECLARED in the lock-order
-    manifest. A new edge is a finding: new lock nesting is a reviewed
-    design decision, not an accident."""
-    from tpubloom.analysis import lock_order
-    from tpubloom.utils import locks
-
-    yield
-    findings = lock_order.check_live()
-    report_dir = os.environ.get(locks.REPORT_DIR_ENV, "")
-    if report_dir and os.path.isdir(report_dir):
-        import glob as _glob
-
-        for path in sorted(
-            _glob.glob(os.path.join(report_dir, "lockcheck-*.json"))
-        ):
-            with open(path) as f:
-                findings.extend(
-                    {**v, "report": os.path.basename(path)}
-                    for v in lock_order.check_report(json.load(f))
-                )
-    assert not findings, (
-        "undeclared lock-order edges (declare deliberately in "
-        "tpubloom/analysis/lock_order.py or fix the nesting):\n"
-        + "\n".join(f"  {f['message']}" for f in findings)
-    )
 
 
 def _wait(pred, timeout=30.0, poll=0.02, msg="condition"):
@@ -447,6 +418,50 @@ def test_migration_resume_takes_tail_path(tmp_path):
             assert not cc.include_batch(n, allkeys).any(), (
                 f"tail resume double-applied records ({n})"
             )
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
+def test_migrate_apply_fault_redrive_exactly_once(tmp_path):
+    """ISSUE 13 (chaos-coverage): ``cluster.migrate_apply`` armed — the
+    TARGET side of a migration dies inside ``MigrateInstall``, the
+    driver surfaces the error, and the re-driven migration completes
+    exactly-once (counts stay 1 at the new owner)."""
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        cc = ClusterClient(startup_nodes=addrs)
+        name = _name_owned_by(a[0].cluster.owner, addrs[0], prefix="ma")
+        slot = S.key_slot(name)
+        cc.create_filter(name, capacity=20_000, error_rate=0.01,
+                         counting=True)
+        keys = [b"ma-%04d" % i for i in range(200)]
+        cc.insert_batch(name, keys)
+
+        before = obs_counters.get("fault_cluster_migrate_apply")
+        # pass 1 is the gate PROBE (its errors are deliberately
+        # swallowed — an unreachable target just means "no resume");
+        # pass 2 is the blob install itself, the one that must surface
+        faults.arm("cluster.migrate_apply", "nth:2", times=1)
+        with pytest.raises(BloomServiceError):
+            BloomClient(addrs[0]).migrate_slot(slot, addrs[1])
+        assert obs_counters.get("fault_cluster_migrate_apply") == before + 1
+        # the handoff did not finalize: the source still owns the slot
+        assert a[0].cluster.owner(slot) == addrs[0]
+
+        # re-drive (disarmed): completes, target owns, exactly-once
+        resp = BloomClient(addrs[0], timeout=120).migrate_slot(
+            slot, addrs[1]
+        )
+        assert resp["ok"]
+        assert b[0].cluster.owner(slot) == addrs[1]
+        assert cc.include_batch(name, keys).all(), "lost writes"
+        cc.delete_batch(name, keys)
+        assert not cc.include_batch(name, keys).any(), (
+            "re-driven install double-applied records"
+        )
         cc.close()
     finally:
         _teardown(a, b)

@@ -39,8 +39,11 @@ from tpubloom.utils.crc32c import crc32c
 
 # ISSUE 6: the whole chaos module runs with the runtime lock-order /
 # held-while-blocking tracker armed (in-process AND subprocess servers);
-# teardown asserts zero violations — see tests/conftest.py.
-pytestmark = pytest.mark.usefixtures("lock_check_armed")
+# teardown asserts zero violations — see tests/conftest.py. ISSUE 13:
+# additionally gated on the declared lock-ORDER manifest — an
+# undeclared acquisition edge anywhere in the armed run fails the
+# module too.
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
 
 
 @pytest.fixture(autouse=True)
@@ -879,3 +882,81 @@ def test_dist_initialize_fault_point():
         initialize_multihost()
     topo = initialize_multihost()  # disarmed: single-host no-op
     assert topo["process_count"] >= 1
+
+
+# -- ISSUE 13 (chaos-coverage closure): the response-loss + per-shard
+# delete points get their own armed drives ----------------------------------
+
+
+def test_rpc_post_handle_response_loss_absorbed_by_dedup():
+    """``rpc.post_handle`` fires AFTER the handler applied (and the
+    barrier/forward ran) but before the response encodes — the "ack lost
+    in flight" case rid-dedup exists for. On a counting filter, the
+    same-rid retry must answer from the cache instead of incrementing a
+    second time."""
+    service = BloomService()
+    srv, port = build_server(service, "127.0.0.1:0")
+    srv.start()
+    client = BloomClient(f"127.0.0.1:{port}", max_retries=0)
+    try:
+        client.wait_ready()
+        client.create_filter(
+            "cnt", capacity=20_000, error_rate=0.01, counting=True
+        )
+        keys = [b"pl-%04d" % i for i in range(64)]
+        req = client._encode_keys({"name": "cnt"}, keys)
+
+        faults.arm("rpc.post_handle", "once")
+        with pytest.raises(BloomServiceError, match="INTERNAL"):
+            client._rpc("InsertBatch", dict(req), rid="post-handle-rid-1")
+        assert obs_counters.get("fault_rpc_post_handle") >= 1
+        # the apply LANDED even though the response was lost
+        assert client.include_batch("cnt", keys).all()
+
+        # same-rid retry: served from the dedup cache, no second apply
+        resp = client._rpc("InsertBatch", dict(req), rid="post-handle-rid-1")
+        assert resp["ok"] and resp["n"] == len(keys)
+        # exactly-once proof: counts are 1, so ONE delete round empties
+        client.delete_batch("cnt", keys)
+        assert not client.include_batch("cnt", keys).any(), (
+            "retry after rpc.post_handle double-applied the increments"
+        )
+    finally:
+        client.close()
+        srv.stop(grace=None)
+
+
+def test_shard_delete_fault_point_predicate_partial_failure():
+    """``shard.delete`` mirrors the insert/query chaos contract on the
+    delete path: with a ``shard=N`` predicate only batches routing a key
+    to shard N die, other shards keep deleting — and the poisoned
+    shard's counts are untouched (no partial decrement before the
+    fault: it fires host-side, before the launch)."""
+    from tpubloom.parallel.sharded import ShardedBloomFilter
+
+    cfg = FilterConfig(m=1 << 20, k=4, key_len=16, shards=8, counting=True)
+    f = ShardedBloomFilter(cfg)
+    rng = np.random.default_rng(13)
+    keys = _rand_keys(256, rng)
+    routes = _routes_of(cfg, keys)
+    target = int(routes[0])
+    hit = [k for k, r in zip(keys, routes) if r == target][:8]
+    miss = [k for k, r in zip(keys, routes) if r != target][:32]
+    assert hit and miss, "batch did not spread over shards"
+    f.insert_batch(hit + miss)  # every count exactly 1
+
+    faults.arm("shard.delete", "always", pred={"shard": target})
+    # a delete touching the target shard dies WHOLE (fired pre-launch)...
+    with pytest.raises(faults.InjectedFault):
+        f.delete_batch(hit[:4])
+    assert np.asarray(f.include_batch(hit)).all(), (
+        "failed delete decremented anyway"
+    )
+    # ...but deletes routed around it land fine (partial failure)
+    f.delete_batch(miss)
+    assert not np.asarray(f.include_batch(miss)).any()
+    assert obs_counters.get("fault_shard_delete") >= 1
+    faults.disarm("shard.delete")
+
+    f.delete_batch(hit)  # the shard heals: counts reach zero
+    assert not np.asarray(f.include_batch(hit)).any()

@@ -49,7 +49,9 @@ from tpubloom.server.service import BloomService, build_server
 
 # ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
 # module (asserted violation-free at teardown — tests/conftest.py).
-pytestmark = pytest.mark.usefixtures("lock_check_armed")
+# ISSUE 13: plus the lock-ORDER manifest gate — every runtime
+# acquisition edge this module drives must be declared.
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
 
 
 @pytest.fixture(autouse=True)
@@ -1144,3 +1146,121 @@ def test_client_sentinels_unreachable_raises_no_topology():
     c = BloomClient("127.0.0.1:2", sentinels=["127.0.0.1:1"])
     assert c.address == "127.0.0.1:2"
     c.close()
+
+
+# -- ISSUE 13 (chaos-coverage closure): the promotion / vote / chained
+# re-append fault points get their own armed drives --------------------------
+
+
+def test_promote_fault_point_aborts_promotion_cleanly(tmp_path):
+    """``ha.promote`` fires at the very top of replica→primary
+    promotion: an armed firing must abort the flip BEFORE any state
+    changed — the node stays a fenced read-only replica and a later
+    (disarmed) promote succeeds from scratch."""
+    psvc, psrv, pport, poplog = _primary(tmp_path)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    pc.create_filter("cnt", capacity=10_000, error_rate=0.01, counting=True)
+    pc.insert_batch("cnt", [b"k%015d" % i for i in range(50)])
+
+    rsvc, rsrv, rport, applier = _replica(
+        tmp_path, pport, name="chainlog", chained=True
+    )
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_for_seq(poplog.last_seq, 30), applier.status()
+
+        faults.arm("ha.promote", "always")
+        with pytest.raises(BloomServiceError, match="INTERNAL"):
+            rc.promote()
+        # nothing flipped: still a fenced replica on epoch 0
+        h = rc.health()
+        assert h["role"] == "replica" and rsvc.read_only
+        assert obs_counters.get("fault_ha_promote") >= 1
+        # raw call (the stock client would auto-redirect to the primary):
+        # the node itself still fences writes
+        with pytest.raises(BloomServiceError, match="READONLY"):
+            rc._call_once("InsertBatch", {"name": "cnt", "keys": [b"fenced"]})
+
+        faults.disarm("ha.promote")  # the aborted promotion re-drives
+        resp = rc.promote()
+        assert resp["ok"] and not resp["already_primary"]
+        assert rc.health()["role"] == "primary"
+        rc.insert_batch("cnt", [b"post-promo-write"])
+        assert rc.include("cnt", b"post-promo-write")
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        poplog.close()
+        if rsvc.oplog is not None:
+            rsvc.oplog.close()
+
+
+def test_vote_fault_point_injects_into_grant_path():
+    """``ha.vote`` armed: the grant path dies mid-election (the caller
+    sees a dead peer, exactly what the quorum loop tolerates) and the
+    vote is NOT spent — once disarmed the same epoch is still
+    grantable, so an injected vote failure cannot silently burn the
+    term the way a granted-then-lost frame would."""
+    s = Sentinel("127.0.0.1:1", peers=[], quorum=2)
+    s._sdown = True
+    faults.arm("ha.vote", "once")
+    with pytest.raises(faults.InjectedFault):
+        s.handle_VoteDown({"epoch": 1, "primary": "127.0.0.1:1"})
+    assert obs_counters.get("fault_ha_vote") >= 1
+    # the fault fired BEFORE the vote registered: epoch 1 is still live
+    assert s.handle_VoteDown({"epoch": 1, "primary": "127.0.0.1:1"})["granted"]
+    # and the term discipline still holds afterwards
+    assert not s.handle_VoteDown(
+        {"epoch": 1, "primary": "127.0.0.1:1"}
+    )["granted"]
+
+
+def test_chained_reappend_fault_heals_exactly_once(tmp_path):
+    """``repl.reappend`` armed on a chained replica: the write-ahead
+    re-append dies, the applier reconnects, and the re-delivered record
+    lands in the local log + filter exactly once (the chained log keeps
+    the upstream seq space gap-free)."""
+    psvc, psrv, pport, poplog = _primary(tmp_path)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    keys = [b"r%015d" % i for i in range(150)]
+    pc.create_filter("cnt", capacity=20_000, error_rate=0.01, counting=True)
+    pc.insert_batch("cnt", keys)
+
+    mid_svc, mid_srv, mid_port, mid_app = _replica(
+        tmp_path, pport, name="midlog", chained=True
+    )
+    mc = BloomClient(f"127.0.0.1:{mid_port}")
+    try:
+        assert mid_app.wait_for_seq(poplog.last_seq, 30), mid_app.status()
+        assert mid_svc.oplog.last_seq == poplog.last_seq
+
+        before = obs_counters.get("fault_repl_reappend")
+        faults.arm("repl.reappend", "once")
+        live = [b"live-%07d" % i for i in range(40)]
+        pc.insert_batch("cnt", live)
+        assert mid_app.wait_for_seq(poplog.last_seq, 30), mid_app.status()
+        assert obs_counters.get("fault_repl_reappend") == before + 1
+        # the chained log re-converged on the upstream seq space
+        assert mid_svc.oplog.last_seq == poplog.last_seq
+        assert mc.include_batch("cnt", live).all()
+
+        # exactly-once: ONE delete round empties every count
+        pc.delete_batch("cnt", keys + live)
+        assert mid_app.wait_for_seq(poplog.last_seq, 30)
+        assert not mc.include_batch("cnt", keys + live).any(), (
+            "re-delivered record double-applied through the chained log"
+        )
+    finally:
+        mid_app.stop()
+        mc.close()
+        pc.close()
+        mid_srv.stop(grace=None)
+        psrv.stop(grace=None)
+        poplog.close()
+        if mid_svc.oplog is not None:
+            mid_svc.oplog.close()

@@ -37,7 +37,9 @@ from tpubloom.server.client import BloomClient
 from tpubloom.server.ingest import CoalesceConfig
 from tpubloom.server.service import BloomService, build_server
 
-pytestmark = pytest.mark.usefixtures("lock_check_armed")
+# ISSUE 13: the manifest gate fixture moved to tests/conftest.py —
+# shared by all five armed chaos modules
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
 
 
 @pytest.fixture(autouse=True)
@@ -45,35 +47,6 @@ def _disarm_all():
     faults.reset()
     yield
     faults.reset()
-
-
-@pytest.fixture(scope="module", autouse=True)
-def lock_order_manifest(lock_check_armed):
-    """The new ``ingest.*`` lock ranks must be DECLARED: after the whole
-    armed module ran, every runtime acquisition edge must be in the
-    lock-order manifest (ROADMAP item 7 discipline)."""
-    import glob
-    import json
-
-    from tpubloom.analysis import lock_order
-    from tpubloom.utils import locks
-
-    yield
-    findings = lock_order.check_live()
-    report_dir = os.environ.get(locks.REPORT_DIR_ENV, "")
-    if report_dir and os.path.isdir(report_dir):
-        for path in sorted(
-            glob.glob(os.path.join(report_dir, "lockcheck-*.json"))
-        ):
-            with open(path) as f:
-                findings.extend(
-                    {**v, "report": os.path.basename(path)}
-                    for v in lock_order.check_report(json.load(f))
-                )
-    assert not findings, (
-        "undeclared lock-order edges:\n"
-        + "\n".join(f"  {f['message']}" for f in findings)
-    )
 
 
 class _Server:

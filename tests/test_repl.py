@@ -702,6 +702,55 @@ def test_replica_end_to_end_with_mid_stream_kill(tmp_path):
         oplog.close()
 
 
+def test_replica_survives_injected_apply_fault_exactly_once(tmp_path):
+    """ISSUE 13 (chaos-coverage): ``repl.apply`` armed on the replica —
+    a record's apply handler dies mid-stream, the applier reconnects
+    with its cursor, and the seq-gated re-delivery applies the record
+    EXACTLY once (counting counts stay 1)."""
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog)
+    pc = BloomClient(f"127.0.0.1:{pport}")
+    pc.wait_ready()
+    rng = np.random.default_rng(7)
+    keys = _rand_keys(300, rng)
+    pc.create_filter("cnt", capacity=20_000, error_rate=0.01, counting=True)
+    pc.insert_batch("cnt", keys)
+
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05
+    ).start()
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        assert applier.wait_caught_up(30), applier.status()
+
+        # poison the NEXT apply: the stream dies inside the handler,
+        # the reconnect re-delivers from the cursor
+        before = obs_counters.get("fault_repl_apply")
+        faults.arm("repl.apply", "once")
+        extra = _rand_keys(100, rng)
+        pc.insert_batch("cnt", extra)
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert obs_counters.get("fault_repl_apply") == before + 1
+        assert rc.include_batch("cnt", extra).all()
+
+        # exactly-once proof: every count is 1, ONE delete round empties
+        pc.delete_batch("cnt", keys + extra)
+        assert applier.wait_for_seq(oplog.last_seq, 30), applier.status()
+        assert not rc.include_batch("cnt", keys + extra).any(), (
+            "re-delivered record double-applied past the injected fault"
+        )
+    finally:
+        applier.stop()
+        rc.close()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
 def test_replica_full_resync_on_restored_create(tmp_path):
     """A CreateFilter that bootstrapped from a checkpoint the replica
     does not have forces a full resync (the record alone cannot carry

@@ -44,7 +44,9 @@ from tpubloom.server.service import BloomService, build_server
 
 # ISSUE 6: armed lock-order / held-while-blocking tracking for the whole
 # module (asserted violation-free at teardown — tests/conftest.py).
-pytestmark = pytest.mark.usefixtures("lock_check_armed")
+# ISSUE 13: plus the lock-ORDER manifest gate — every runtime
+# acquisition edge this module drives must be declared.
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
 
 
 @pytest.fixture(autouse=True)

@@ -1,13 +1,26 @@
-"""Project-specific correctness tooling (ISSUE 6).
+"""Project-specific correctness tooling (ISSUE 6, grown in ISSUE 13).
 
-Two layers keep the concurrent subsystems honest:
+Three layers keep the concurrent subsystems honest:
 
 * :mod:`tpubloom.analysis.lint` — static AST checkers encoding the
-  invariants review kept re-finding in PRs 3-5 (no blocking calls under
-  the registry/filter locks, op-log append ordered before
-  ``notify_inserts``, protocol/fault-point/metric-name registries
-  closed under cross-reference). Run ``python -m tpubloom.analysis.lint``.
+  invariants review kept re-finding as PRs 3-12 grew the stack: no
+  blocking calls under the registry/filter locks, quorum barriers
+  outside every lock, op-log append ordered before ``notify_inserts``,
+  no use-after-donate on device buffers, every mutating handler
+  replay-cached (or argued safe), and the protocol/fault-point/metric/
+  phase registries closed under cross-reference — including that every
+  declared fault point is actually ARMED by some test.
+* :mod:`tpubloom.analysis.lock_order` — the declared lock-ORDER
+  manifest every armed chaos module's runtime acquisition graph is
+  diffed against at teardown (all five: faults, ha, sync_repl,
+  cluster, ingest).
 * :mod:`tpubloom.utils.locks` — runtime lock-order and
   held-while-blocking analysis behind the ``TPUBLOOM_LOCK_CHECK`` env
-  var, armed in the chaos suites.
+  var, armed in the chaos suites; its exit reports feed the manifest
+  diff.
+
+``python -m tpubloom.analysis [--json]`` is the unified driver: the
+full static lint plus the manifest diff over collected
+``lockcheck-*.json`` reports, one exit code (see
+:mod:`tpubloom.analysis.__main__`).
 """

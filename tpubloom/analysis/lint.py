@@ -14,12 +14,12 @@ Checks
     ``_peer``/``grpc.insecure_channel``), ``Condition.wait`` without a
     timeout, fsync/flush/checkpoint IO (``os.fsync``, ``.flush()``,
     ``ckpt.restore``/``_tracked_restore``, ``checkpointer.close``),
-    quorum waits (``wait_acked``, ``commit_barrier``), ``time.sleep``,
-    thread/worker ``join``, ``Future.result`` — lexically inside a
-    ``with`` on a registry/filter/admission mutex or a lock-named
-    condition (attributes like ``lock``, ``_lock``, ``_cond``,
-    ``_admit_lock`` ...). The runtime half of this check is
-    :func:`tpubloom.utils.locks.note_blocking`.
+    ``time.sleep``, thread/worker ``join``, ``Future.result`` —
+    lexically inside a ``with`` on a registry/filter/admission mutex
+    or a lock-named condition (attributes like ``lock``, ``_lock``,
+    ``_cond``, ``_admit_lock`` ...). Quorum waits moved to their own
+    ``barrier-outside-lock`` check in ISSUE 13. The runtime half of
+    this check is :func:`tpubloom.utils.locks.note_blocking`.
 
 ``notify-before-append``
     In any function that both appends to the op log (``_log_op`` /
@@ -55,6 +55,52 @@ Checks
     matches the protocol list exactly (no drift in either direction),
     and the registry lists nothing the protocol dropped.
 
+``donation-safety``
+    A name passed at a donated position of a donating call — a callable
+    built by ``jax.jit(..., donate_argnums=...)`` or ``pl.pallas_call(
+    ..., input_output_aliases=...)`` — must not be referenced after the
+    call in the same function unless it was rebound first: donation
+    deletes the buffer on device, so a later use raises (best case) or
+    reads freed memory through a stale handle (the PR-10 ``InFlight``
+    fence bug class, found live when a later donating kernel deleted
+    the fenced handle).
+
+``replay-safety``
+    (tree mode) Every ``protocol.MUTATING_METHODS`` handler on
+    ``BloomService`` must touch the rid→response dedup cache
+    (``_dedup_get``/``_dedup_put``) — a mutating response that is not
+    replay-cached turns a client retry into a second apply (the
+    PR-9/10 double-apply class: counting filters double-increment,
+    presence replays report the batch's own keys). Handlers whose
+    replay provably converges carry a reasoned suppression on the
+    ``def`` line instead.
+
+``barrier-outside-lock``
+    ``commit_barrier`` / ``wait_acked`` lexically under a registry/
+    filter/admission lock ``with``. The PR-5 invariant, previously
+    prose: the commit barrier runs in the RPC wrapper AFTER the
+    handler, outside every lock — a quorum wait under the filter lock
+    would stall every other writer (and the ack path it waits on) for
+    the full barrier budget.
+
+``chaos-coverage``
+    (tree mode) Every ``faults.KNOWN_POINTS`` entry is ARMED by literal
+    in at least one test — via ``faults.arm("point", ...)`` or a
+    ``TPUBLOOM_FAULTS``-syntax string (``"point=policy"``) in
+    ``tests/``. A declared-but-never-armed point is dead chaos surface:
+    the failure path it guards has never actually been driven.
+    Suppress (with a reason) on the point's ``KNOWN_POINTS`` line.
+
+``phase-registry``
+    Every literal phase name passed to ``obs.phase(...)`` /
+    ``ctx.add_phase(...)`` is declared in
+    :data:`tpubloom.obs.names.PHASES`; dynamic (f-string) phase names
+    must start with a declared :data:`tpubloom.obs.names.
+    PHASE_DYNAMIC_PREFIXES` prefix (``kernel_shard<i>``); (tree mode)
+    every declared phase/prefix is emitted somewhere — the PR-6
+    counter-registry pattern extended to the phase vocabulary so
+    dashboards and the slowlog keep lining up.
+
 Suppressions
 ============
 
@@ -87,6 +133,11 @@ CHECKS = (
     "metric-registry",
     "protocol-coverage",
     "ruby-parity",
+    "donation-safety",
+    "replay-safety",
+    "barrier-outside-lock",
+    "chaos-coverage",
+    "phase-registry",
     "suppression-reason",
     "unknown-suppression",
     "unused-suppression",
@@ -108,9 +159,14 @@ LOCK_ATTRS = frozenset(
 
 #: Method names that are blocking wherever they appear.
 BLOCKING_METHOD_NAMES = frozenset(
-    {"wait_acked", "commit_barrier", "_tracked_restore",
-     "_rpc", "_node", "_peer", "result", "flush"}
+    {"_tracked_restore", "_rpc", "_node", "_peer", "result", "flush"}
 )
+
+#: Quorum-barrier calls: under a lock these get their own check
+#: (``barrier-outside-lock`` — the PR-5 invariant, formerly prose and
+#: formerly folded into blocking-under-lock): the commit barrier runs
+#: in the RPC wrapper AFTER the handler, outside every lock.
+BARRIER_METHOD_NAMES = frozenset({"wait_acked", "commit_barrier"})
 
 #: Fully dotted calls that are blocking.
 BLOCKING_DOTTED = frozenset(
@@ -159,6 +215,9 @@ class LintConfig:
     #: declared metric names (None = parse ``tpubloom/obs/names.py``)
     counters: Optional[frozenset] = None
     gauges: Optional[frozenset] = None
+    #: declared phase vocabulary (None = parse ``tpubloom/obs/names.py``)
+    phases: Optional[frozenset] = None
+    phase_prefixes: Optional[tuple] = None
     #: run the cross-file tree checks (protocol coverage + reverse
     #: registry checks) against ``repo_root``
     tree_checks: bool = True
@@ -262,6 +321,16 @@ def _is_lock_with_item(item: ast.withitem) -> Optional[str]:
     return None
 
 
+def _barrier_name(call: ast.Call) -> Optional[str]:
+    """Dotted rendering of a quorum-barrier call, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in BARRIER_METHOD_NAMES:
+        return f"{_dotted(func.value)}.{func.attr}"
+    if isinstance(func, ast.Name) and func.id in BARRIER_METHOD_NAMES:
+        return func.id
+    return None
+
+
 def _blocking_reason(call: ast.Call) -> Optional[str]:
     func = call.func
     if isinstance(func, ast.Attribute):
@@ -313,6 +382,10 @@ class _FileVisitor(ast.NodeVisitor):
         self.metric_uses: list = []
         #: (point, line) literal fault-point usages
         self.fault_uses: list = []
+        #: (name, line) literal phase emissions (obs.phase / add_phase)
+        self.phase_uses: list = []
+        #: (literal-prefix, line) dynamic (f-string) phase emissions
+        self.phase_dynamic_uses: list = []
         #: every string constant in the file (reverse fault check)
         self.str_constants: set = set()
 
@@ -362,6 +435,7 @@ class _FileVisitor(ast.NodeVisitor):
         self._collect_ordering(node)
         self._collect_fault_use(node)
         self._collect_metric_use(node)
+        self._collect_phase_use(node)
         self.generic_visit(node)
 
     # -- checks -------------------------------------------------------------
@@ -375,10 +449,23 @@ class _FileVisitor(ast.NodeVisitor):
     def _check_blocking(self, node: ast.Call) -> None:
         if not self._locks:
             return
+        lock, with_line = self._locks[-1]
+        barrier = _barrier_name(node)
+        if barrier is not None:
+            f = Finding(
+                "barrier-outside-lock", self.path, node.lineno,
+                f"{barrier}() runs a quorum barrier while holding "
+                f"{lock!r} (with at line {with_line}) — the PR-5 "
+                f"invariant: commit barriers run in the RPC wrapper "
+                f"AFTER the handler, outside every lock, or one slow "
+                f"quorum stalls every other writer on this filter",
+            )
+            self._suppressed(f, (with_line,))
+            self.findings.append(f)
+            return
         reason = _blocking_reason(node)
         if reason is None:
             return
-        lock, with_line = self._locks[-1]
         f = Finding(
             "blocking-under-lock", self.path, node.lineno,
             f"{reason} while holding {lock!r} (with at line {with_line})",
@@ -432,6 +519,157 @@ class _FileVisitor(ast.NodeVisitor):
         kind = "gauge" if attr == "set_gauge" else "counter"
         self.metric_uses.append((node.args[0].value, kind, node.lineno))
 
+    def _collect_phase_use(self, node: ast.Call) -> None:
+        """Literal/dynamic phase names at ``obs.phase(...)`` /
+        ``ctx.add_phase(...)`` sites (ISSUE 13 ``phase-registry``)."""
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in ("phase", "add_phase") or not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.phase_uses.append((arg.value, node.lineno))
+        elif isinstance(arg, ast.JoinedStr):
+            head = ""
+            if arg.values and isinstance(arg.values[0], ast.Constant):
+                head = str(arg.values[0].value)
+            self.phase_dynamic_uses.append((head, node.lineno))
+
+
+# -- donation safety (ISSUE 13) ----------------------------------------------
+
+
+def _donated_indices(call: ast.Call) -> tuple:
+    """Donated positional-arg indices declared on a ``jax.jit(...,
+    donate_argnums=...)`` / ``pl.pallas_call(..., input_output_aliases=
+    {in_idx: out_idx, ...})`` construction, else ``()``."""
+    for kw in call.keywords:
+        if kw.arg == "input_output_aliases" and isinstance(kw.value, ast.Dict):
+            return tuple(
+                k.value
+                for k in kw.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, int)
+            )
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+    return ()
+
+
+def _collect_donating_callees(tree: ast.AST) -> dict:
+    """``{dotted-callee: (donated indices,)}`` for every assignment in
+    the file whose value is a donating construction — ``fn = pl.
+    pallas_call(..., input_output_aliases=...)`` in a kernel builder,
+    ``self._insert = jax.jit(..., donate_argnums=0)`` in a filter class.
+    Keyed on the rendered target (``fn``, ``self._insert``) so calls
+    through the same spelling anywhere in the file resolve."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        idxs = _donated_indices(node.value)
+        if not idxs:
+            continue
+        for t in node.targets:
+            if isinstance(t, (ast.Name, ast.Attribute)):
+                out[_dotted(t)] = idxs
+    return out
+
+
+def _binding_lines(func: ast.AST, expr: str) -> list:
+    """Line numbers where ``expr`` (a dotted name) is (re)bound inside
+    ``func`` — assignment targets incl. tuple unpacking, aug-assign,
+    for-loop targets, ``with ... as`` — i.e. the points after which a
+    previously donated buffer name holds a FRESH value again."""
+    lines = []
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        if isinstance(node, ast.For):
+            return [node.target]
+        if isinstance(node, ast.withitem) and node.optional_vars is not None:
+            return [node.optional_vars]
+        return []
+
+    for node in ast.walk(func):
+        for t in targets_of(node):
+            for sub in ast.walk(t):
+                if isinstance(sub, (ast.Name, ast.Attribute)) and (
+                    _dotted(sub) == expr
+                ):
+                    lines.append(node.lineno)
+    return lines
+
+
+def check_donation_safety(tree: ast.AST, path: str) -> list:
+    """Use-after-donate: a name passed at a donated position and read
+    again later in the same function without a rebind in between. The
+    donated device buffer is DELETED by the call — the PR-10 bug class
+    where a later donating kernel consumed the handle an in-flight
+    fence still held."""
+    donating = _collect_donating_callees(tree)
+    if not donating:
+        return []
+    findings: list = []
+    funcs = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for func in funcs:
+        for call in ast.walk(func):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = _dotted(call.func)
+            idxs = donating.get(callee)
+            if not idxs:
+                continue
+            call_end = call.end_lineno or call.lineno
+            for i in idxs:
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                expr = _dotted(arg)
+                rebinds = _binding_lines(func, expr)
+                for node in ast.walk(func):
+                    if not isinstance(node, (ast.Name, ast.Attribute)):
+                        continue
+                    if not isinstance(getattr(node, "ctx", None), ast.Load):
+                        continue
+                    if node.lineno <= call_end or _dotted(node) != expr:
+                        continue
+                    if any(
+                        call.lineno <= rb <= node.lineno for rb in rebinds
+                    ):
+                        continue
+                    f = Finding(
+                        "donation-safety", path, node.lineno,
+                        f"{expr!r} was donated to {callee}() at line "
+                        f"{call.lineno} (donated arg {i}) and is read "
+                        f"again here without a rebind — donation deletes "
+                        f"the device buffer, so this read raises or "
+                        f"races freed memory (the PR-10 InFlight fence "
+                        f"class)",
+                    )
+                    f._lines = (node.lineno, call.lineno)  # type: ignore[attr-defined]
+                    findings.append(f)
+                    break  # one finding per donated arg per call
+    return findings
+
 
 def _apply_registry_checks(
     visitor: _FileVisitor, config: LintConfig
@@ -469,11 +707,42 @@ def _apply_registry_checks(
             f = Finding("metric-registry", visitor.path, line, msg)
             f._lines = (line,)  # type: ignore[attr-defined]
             visitor.findings.append(f)
+    if config.phases is not None:
+        prefixes = tuple(config.phase_prefixes or ())
+        for name, line in visitor.phase_uses:
+            if name in config.phases or any(
+                name.startswith(p) for p in prefixes
+            ):
+                continue
+            f = Finding(
+                "phase-registry", visitor.path, line,
+                f"phase {name!r} is not declared in tpubloom.obs.names."
+                f"PHASES — the phase vocabulary is central so dashboards, "
+                f"bench.py and the slowlog line up",
+            )
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
+        for head, line in visitor.phase_dynamic_uses:
+            if head and any(head.startswith(p) for p in prefixes):
+                continue
+            f = Finding(
+                "phase-registry", visitor.path, line,
+                f"dynamic phase name with literal head {head!r} matches "
+                f"no declared PHASE_DYNAMIC_PREFIXES entry in "
+                f"tpubloom.obs.names — minted phase series need a "
+                f"declared shape",
+            )
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
 
 
 def lint_file(path: str, config: LintConfig) -> tuple:
-    """Lint one file; returns (findings, visitor) — the visitor carries
-    the literal collections the tree checks aggregate."""
+    """Lint one file; returns (findings, visitor, suppressions). The
+    visitor carries the literal collections the tree checks aggregate;
+    the suppression table is returned UNRESOLVED for unused-allow
+    accounting because tree-level checks (``chaos-coverage``,
+    ``replay-safety``) may still claim a file's suppressions after
+    every file has been read — :func:`lint_paths` settles them."""
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
     findings: list = []
@@ -484,27 +753,65 @@ def lint_file(path: str, config: LintConfig) -> tuple:
             Finding("blocking-under-lock", path, e.lineno or 0,
                     f"file does not parse: {e.msg}")
         )
-        return findings, None
+        return findings, None, None
     visitor = _FileVisitor(path, config)
     visitor.visit(tree)
     _apply_registry_checks(visitor, config)
+    visitor.findings.extend(check_donation_safety(tree, path))
     sup = _Suppressions(path, source, findings)
     for f in visitor.findings:
         lines = getattr(f, "_lines", (f.line,))
+        # claim the suppression BEFORE the disable filter: disabling a
+        # check must not orphan its reasoned allows into
+        # unused-suppression findings
+        if sup.matches(f.check, *lines):
+            continue
         if f.check in config.disable:
             continue
-        if not sup.matches(f.check, *lines):
-            findings.append(f)
-    findings.extend(sup.unused(path))
-    return [f for f in findings if f.check not in config.disable], visitor
+        findings.append(f)
+    return (
+        [f for f in findings if f.check not in config.disable],
+        visitor,
+        sup,
+    )
 
 
 # -- registry parsing (AST, no heavyweight imports) ---------------------------
 
 
+def _collection_node(value: ast.AST) -> Optional[ast.AST]:
+    """Unwrap ``frozenset({...})`` / ``set([...])`` / ``tuple((...))``
+    wrappers down to the literal collection node, if any."""
+    if isinstance(value, (ast.Tuple, ast.Set, ast.List)):
+        return value
+    if (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Name)
+        and value.func.id in ("frozenset", "set", "tuple", "list")
+        and len(value.args) == 1
+    ):
+        return _collection_node(value.args[0])
+    return None
+
+
 def _parse_string_collection(path: str, target_names: Iterable[str]) -> dict:
     """``{name: [literals...]}`` for module-level assignments of string
-    tuples/sets/lists named in ``target_names`` (duplicates preserved)."""
+    tuples/sets/lists named in ``target_names`` (duplicates preserved;
+    ``frozenset({...})``-style wrappers unwrapped)."""
+    return {
+        name: [v for v, _line in items]
+        for name, items in _parse_string_collection_lines(
+            path, target_names
+        ).items()
+    }
+
+
+def _parse_string_collection_lines(
+    path: str, target_names: Iterable[str]
+) -> dict:
+    """Like :func:`_parse_string_collection` but each entry is
+    ``(literal, lineno)`` — tree checks anchor findings (and accept
+    suppressions) on the declaration line itself."""
     out: dict = {}
     with open(path, "r", encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
@@ -512,13 +819,14 @@ def _parse_string_collection(path: str, target_names: Iterable[str]) -> dict:
     for node in tree.body:
         if not isinstance(node, ast.Assign):
             continue
+        coll = _collection_node(node.value)
+        if coll is None:
+            continue
         for t in node.targets:
-            if isinstance(t, ast.Name) and t.id in wanted and isinstance(
-                node.value, (ast.Tuple, ast.Set, ast.List)
-            ):
+            if isinstance(t, ast.Name) and t.id in wanted:
                 out[t.id] = [
-                    e.value
-                    for e in node.value.elts
+                    (e.value, e.lineno)
+                    for e in coll.elts
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)
                 ]
     return out
@@ -531,6 +839,36 @@ def load_fault_points(repo_root: str) -> frozenset:
             "KNOWN_POINTS", ()
         )
     )
+
+
+def load_phase_names(repo_root: str) -> tuple:
+    """(phases, dynamic prefixes) from obs/names.py (ISSUE 13); empty
+    when the catalog is absent (partial fixture trees)."""
+    path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    if not os.path.isfile(path):
+        return frozenset(), ()
+    decls = _parse_string_collection(path, ("PHASES",))
+    phases = frozenset(decls.get("PHASES", ()))
+    prefixes = []
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "PHASE_DYNAMIC_PREFIXES":
+                coll = _collection_node(node.value)
+                for e in (coll.elts if coll is not None else ()):
+                    # entries are (prefix, why) pairs like DYNAMIC_PREFIXES
+                    inner = _collection_node(e)
+                    if (
+                        inner is not None
+                        and inner.elts
+                        and isinstance(inner.elts[0], ast.Constant)
+                        and isinstance(inner.elts[0].value, str)
+                    ):
+                        prefixes.append(inner.elts[0].value)
+    return phases, tuple(prefixes)
 
 
 def load_metric_names(repo_root: str) -> tuple:
@@ -609,10 +947,12 @@ def check_protocol_coverage(repo_root: str) -> list:
     """Every METHODS entry: handler + client call + golden test; every
     streaming method: behavior registration + golden test."""
     proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
+    service_path = os.path.join(repo_root, "tpubloom", "server", "service.py")
+    if not os.path.isfile(proto_path) or not os.path.isfile(service_path):
+        return []  # partial fixture tree: nothing to cross-reference
     decls = _parse_string_collection(
         proto_path, ("METHODS", "STREAM_METHODS", "CLIENT_STREAM_METHODS")
     )
-    service_path = os.path.join(repo_root, "tpubloom", "server", "service.py")
     client_path = os.path.join(repo_root, "tpubloom", "server", "client.py")
     golden_path = os.path.join(repo_root, "tests", "test_protocol_golden.py")
     handlers, behaviors = _service_handlers(service_path)
@@ -661,6 +1001,8 @@ def check_ruby_parity(repo_root: str) -> list:
     that forgets the Ruby side fails CI the same way a missing Python
     handler does."""
     proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
+    if not os.path.isfile(proto_path):
+        return []  # partial fixture tree
     decls = _parse_string_collection(proto_path, ("METHODS",))
     methods = list(decls.get("METHODS", ()))
     driver_dir = os.path.join(repo_root, RUBY_DRIVER_DIR)
@@ -709,6 +1051,130 @@ def check_ruby_parity(repo_root: str) -> list:
     return findings
 
 
+def check_replay_safety(repo_root: str) -> list:
+    """Every ``protocol.MUTATING_METHODS`` handler on ``BloomService``
+    touches the rid→response dedup cache (``_dedup_get``/``_dedup_put``)
+    — the PR-9/10 double-apply class: a mutating response that is not
+    replay-cached turns a same-rid client retry into a second apply
+    (counting filters double-increment, presence replays report the
+    batch's own keys as pre-existing). Handlers whose replay provably
+    CONVERGES instead carry a reasoned ``# lint: allow(replay-safety)``
+    on the ``def`` line — the reason documents the convergence
+    argument, which is exactly what hand-review kept re-deriving."""
+    proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
+    service_path = os.path.join(repo_root, "tpubloom", "server", "service.py")
+    if not os.path.isfile(proto_path) or not os.path.isfile(service_path):
+        return []  # partial fixture tree
+    mutating = set(
+        _parse_string_collection(proto_path, ("MUTATING_METHODS",)).get(
+            "MUTATING_METHODS", ()
+        )
+    )
+    findings: list = []
+    if not mutating:
+        return findings
+    with open(service_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=service_path)
+    for node in tree.body:
+        if not (isinstance(node, ast.ClassDef) and node.name == "BloomService"):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in mutating:
+                continue
+            touches_dedup = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr in ("_dedup_get", "_dedup_put")
+                for c in ast.walk(fn)
+            )
+            if touches_dedup:
+                continue
+            f = Finding(
+                "replay-safety", service_path, fn.lineno,
+                f"mutating handler {fn.name}() never touches the rid "
+                f"dedup cache (_dedup_get/_dedup_put) — a same-rid retry "
+                f"of a response that was lost in flight re-applies the "
+                f"op (the PR-9/10 double-apply class); cache the "
+                f"response, or suppress with the convergence argument",
+            )
+            f._lines = (fn.lineno,)  # type: ignore[attr-defined]
+            findings.append(f)
+    return findings
+
+
+#: Where the chaos-coverage check looks for arming sites.
+TESTS_DIR = "tests"
+
+_FAULT_ENV_RE = re.compile(r"([a-z_]+(?:\.[a-z_]+)+)\s*=")
+
+
+def _collect_armed_points(tests_dir: str, known: frozenset) -> set:
+    """Fault points armed by literal anywhere under ``tests/``: a
+    ``faults.arm("point", ...)`` call, or a ``TPUBLOOM_FAULTS``-syntax
+    string constant (``"point=policy[,point=policy...]"``)."""
+    armed: set = set()
+    for path in iter_py_files([tests_dir]):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "arm"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                armed.add(node.args[0].value)
+            elif isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                for m in _FAULT_ENV_RE.finditer(node.value):
+                    if m.group(1) in known:
+                        armed.add(m.group(1))
+    return armed
+
+
+def check_chaos_coverage(repo_root: str) -> list:
+    """Every declared fault point is ARMED in at least one test
+    (ISSUE 13): a ``KNOWN_POINTS`` entry nobody ever arms is dead chaos
+    surface — the failure path it guards compiles, fires a counter, and
+    has never once been driven. Findings anchor on the point's
+    declaration line so a reasoned suppression lives next to the
+    vocabulary it covers."""
+    faults_path = os.path.join(
+        repo_root, "tpubloom", "faults", "__init__.py"
+    )
+    if not os.path.isfile(faults_path):
+        return []  # partial fixture tree
+    decls = _parse_string_collection_lines(
+        faults_path, ("KNOWN_POINTS",)
+    ).get("KNOWN_POINTS", [])
+    if not decls:
+        return []
+    known = frozenset(p for p, _ in decls)
+    armed = _collect_armed_points(os.path.join(repo_root, TESTS_DIR), known)
+    findings = []
+    for point, line in decls:
+        if point in armed:
+            continue
+        f = Finding(
+            "chaos-coverage", faults_path, line,
+            f"fault point {point!r} is declared but never armed in any "
+            f"test (no faults.arm literal, no TPUBLOOM_FAULTS string) — "
+            f"dead chaos surface: add an armed test or suppress here "
+            f"with the reason the path is covered another way",
+        )
+        f._lines = (line,)  # type: ignore[attr-defined]
+        findings.append(f)
+    return findings
+
+
 def iter_py_files(paths: Iterable[str]) -> list:
     out = []
     for p in paths:
@@ -724,6 +1190,19 @@ def iter_py_files(paths: Iterable[str]) -> list:
     return out
 
 
+def _load_suppressions(path: str) -> Optional[_Suppressions]:
+    """On-demand suppression table for a file tree checks anchor in but
+    the linted path set did not cover (grammar findings dropped — the
+    file is not being linted, only consulted)."""
+    if not path.endswith(".py") or not os.path.isfile(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return _Suppressions(path, f.read(), [])
+    except (OSError, SyntaxError):
+        return None
+
+
 def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> list:
     config = config or LintConfig()
     repo_root = config.repo_root or _repo_root()
@@ -736,28 +1215,44 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
         config.gauges = gauges
         if config.tree_checks:
             findings.extend(dup_findings)
+    if config.phases is None:
+        config.phases, config.phase_prefixes = load_phase_names(repo_root)
 
     fault_literal_seen: set = set()
     metric_literal_seen: set = set()
+    phase_literal_seen: set = set()
+    phase_prefix_seen: set = set()
     fault_registry_path = os.path.join(
         repo_root, "tpubloom", "faults", "__init__.py"
     )
     names_path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    #: abspath -> (display path, _Suppressions), settled only after the
+    #: tree checks ran; linted_paths bounds unused-allow accounting to
+    #: files that actually went through the per-file checks
+    sups: dict = {}
+    linted_paths: set = set()
     for path in iter_py_files(paths):
-        file_findings, visitor = lint_file(path, config)
+        file_findings, visitor, sup = lint_file(path, config)
         findings.extend(file_findings)
         if visitor is None:
             continue
+        sups[os.path.abspath(path)] = (path, sup)
+        linted_paths.add(os.path.abspath(path))
         if os.path.abspath(path) != os.path.abspath(fault_registry_path):
             fault_literal_seen |= visitor.str_constants
         if os.path.abspath(path) != os.path.abspath(names_path):
             metric_literal_seen |= {n for n, _, _ in visitor.metric_uses}
+        phase_literal_seen |= {n for n, _ in visitor.phase_uses}
+        phase_prefix_seen |= {h for h, _ in visitor.phase_dynamic_uses if h}
 
     if config.tree_checks:
-        findings.extend(check_protocol_coverage(repo_root))
-        findings.extend(check_ruby_parity(repo_root))
+        tree_findings: list = []
+        tree_findings.extend(check_protocol_coverage(repo_root))
+        tree_findings.extend(check_ruby_parity(repo_root))
+        tree_findings.extend(check_replay_safety(repo_root))
+        tree_findings.extend(check_chaos_coverage(repo_root))
         for point in sorted(config.known_fault_points - fault_literal_seen):
-            findings.append(
+            tree_findings.append(
                 Finding(
                     "fault-registry", fault_registry_path, 0,
                     f"declared fault point {point!r} is never referenced "
@@ -767,13 +1262,62 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
         for name in sorted(
             (config.counters | config.gauges) - metric_literal_seen
         ):
-            findings.append(
+            tree_findings.append(
                 Finding(
                     "metric-registry", names_path, 0,
                     f"declared metric {name!r} is never emitted in the "
                     f"linted tree — stale catalog entry",
                 )
             )
+        for name in sorted(config.phases - phase_literal_seen):
+            tree_findings.append(
+                Finding(
+                    "phase-registry", names_path, 0,
+                    f"declared phase {name!r} is never emitted in the "
+                    f"linted tree — stale vocabulary entry",
+                )
+            )
+        for prefix in config.phase_prefixes or ():
+            if not any(
+                h.startswith(prefix) or prefix.startswith(h)
+                for h in phase_prefix_seen
+            ) and not any(
+                n.startswith(prefix) for n in phase_literal_seen
+            ):
+                tree_findings.append(
+                    Finding(
+                        "phase-registry", names_path, 0,
+                        f"declared dynamic phase prefix {prefix!r} has no "
+                        f"emit site in the linted tree — stale "
+                        f"vocabulary entry",
+                    )
+                )
+        # tree findings honor inline suppressions at their anchor line
+        # (the declaration/def they point at), same grammar as per-file
+        for f in tree_findings:
+            key = os.path.abspath(f.path)
+            entry = sups.get(key)
+            if entry is None:
+                loaded = _load_suppressions(f.path)
+                if loaded is not None:
+                    entry = (f.path, loaded)
+                    sups[key] = entry
+            lines = getattr(f, "_lines", (f.line,))
+            # claim BEFORE the disable filter (see lint_file): a
+            # disabled check's reasoned allows must not rot into
+            # unused-suppression findings
+            if entry is not None and entry[1].matches(f.check, *lines):
+                continue
+            if f.check in config.disable:
+                continue
+            findings.append(f)
+    # unused-allow accounting settles LAST, after tree checks had their
+    # chance to claim a file's suppressions — and only for files that
+    # actually went through the per-file checks (a merely-consulted
+    # file's allows can target checks this run never applied to it)
+    for abspath in sorted(linted_paths):
+        display, sup = sups[abspath]
+        findings.extend(sup.unused(display))
     return [f for f in findings if f.check not in config.disable]
 
 

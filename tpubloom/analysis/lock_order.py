@@ -10,21 +10,27 @@ runtime edge outside the manifest is a finding — new nesting is a
 reviewed design decision, not an accident discovered at 3am.
 
 The manifest is a set of ``(outer, inner)`` lock-CLASS pairs (the names
-given to :func:`tpubloom.utils.locks.named_lock` and friends), seeded
-from the edges the chaos suites actually drive — including the new
-``cluster.*`` ranks the slot-migration paths mint (``cluster.state`` is
-a leaf: nothing may be acquired under it except the tracker's own
-bookkeeping, because migration forwards do network IO).
+given to :func:`tpubloom.utils.locks.named_lock` and friends). ISSUE 13
+re-harvested it against the FULL armed fleet — all five chaos modules
+(faults, ha, sync_repl, cluster, ingest) now gate their teardown on
+this diff via the shared ``lock_order_manifest`` fixture in
+tests/conftest.py, closing the ROADMAP-6 seam — and every declared
+edge carries the one-line reason (the minting code path) it exists.
+``cluster.state`` stays a leaf apart from its gauge updates: nothing
+may be acquired under it, because migration forwards do network IO.
 
 Checking:
 
-* :func:`diff_edges` / :func:`check_report` — library API
-  (``tests/test_cluster.py`` runs it over the armed chaos module's
-  tracker + subprocess reports at teardown);
+* :func:`diff_edges` / :func:`check_report` — library API (the shared
+  conftest fixture runs it over every armed chaos module's tracker +
+  subprocess reports at teardown);
 * ``python -m tpubloom.analysis.lock_order [report.json|dir ...]`` —
   operator CLI over ``lockcheck-*.json`` exit reports
   (``$TPUBLOOM_LOCK_CHECK_DIR``); exit 1 on undeclared edges. ``--list``
-  prints the manifest.
+  prints the manifest;
+* ``python -m tpubloom.analysis`` — the ISSUE-13 unified driver folds
+  this diff and the static tree lint into one exit code (what CI's
+  ``analysis`` job runs over the chaos shard's uploaded reports).
 
 Growing the manifest is the point, not a failure: when a new edge is
 legitimate, add it here IN THE SAME PR with the code that mints it.
@@ -41,103 +47,146 @@ from typing import Iterable, Optional
 
 #: The declared acquisition order: (outer, inner) = "inner may be
 #: acquired while outer is held". Everything else is a finding.
+#:
+#: ISSUE 13 re-harvested this manifest against the full armed fleet
+#: (faults/ha/sync_repl joined cluster/ingest behind the shared
+#: ``lock_order_manifest`` teardown gate) and PRUNED 25 edges whose
+#: minting code path no longer exists — the PR-9 lesson applied at
+#: scale: a speculatively-declared edge is a place a real cycle can
+#: hide. Every surviving edge carries the one-line reason it exists
+#: (the code path that mints it); an edge you cannot annotate is an
+#: edge you should not declare. Notable removals: the five
+#: ``repl.applier_call -> {filter.op, repl.oplog, service.registry,
+#: ckpt.trigger, faults.registry}`` edges (records now apply OUTSIDE
+#: the applier's call lock — it guards only the stream/ack handles),
+#: ``repl.sessions -> repl.oplog`` (ReplStream reads the log head
+#: BEFORE entering the sessions condition), and a family of
+#: ``X -> obs.counters`` edges whose counters moved outside their
+#: lock regions as the hot paths were slimmed (faults.registry,
+#: obs.slowlog, service.dedup, ckpt.trigger, ckpt.redis_sink,
+#: repl.monitor_hub, repl.ack_sender, sentinel.topo_events,
+#: cluster.client). The same audit DECLARED one latent edge no suite
+#: had driven yet: ``filter.op -> repl.sessions`` (the truncation
+#: sweep's replica-cursor floor — see below).
 ALLOWED_EDGES = frozenset(
     {
-        # -- op-log commit points (PR 3): the log append happens under
-        #    the lock its op committed under
+        # -- op-log commit points (PR 3): the append happens under the
+        #    lock its op committed under
+        # handlers append from _log_op inside `with mf.lock`
         ("filter.op", "repl.oplog"),
+        # _log_create / DropFilter append inside the registry lock (a
+        # concurrent create/drop of the same name must serialize with
+        # the record order)
         ("service.registry", "repl.oplog"),
-        # the checkpoint-keyed truncation sweep (every 64 appends) runs
-        # from _log_op — i.e. under the committing filter's op lock —
-        # and snapshots the registry. The REVERSE order must never be
-        # declared: registry holders always release before taking an op
-        # lock (create/drop/gauge walks), which is what keeps this a DAG
+        # -- the checkpoint-keyed truncation sweep (every 64 appends,
+        #    _maybe_truncate_log) runs from _log_op — i.e. under the
+        #    committing filter's op lock — and:
+        # ...snapshots the registry for the per-filter landed floors.
+        # The REVERSE order must never be declared: registry holders
+        # always release before taking an op lock (create/drop/gauge
+        # walks), which is what keeps this a DAG
         ("filter.op", "service.registry"),
-        # create/drop maintain the manifest + checkpoint trigger state
-        # under their commit locks
-        ("filter.op", "ckpt.trigger"),
-        ("service.registry", "ckpt.trigger"),
-        ("repl.oplog", "ckpt.trigger"),
-        # filter construction may trigger the native kernel build cache
-        ("filter.op", "native.build"),
-        ("service.registry", "native.build"),
-        # gauge snapshots read per-filter state under the op lock
+        # ...bounds GC by the slowest replica's cursor —
+        # repl_sessions.min_cursor() takes the sessions condition.
+        # Declared by the ISSUE-13 audit: reachable on every 64th
+        # append, but no armed module had crossed the boundary on one
+        # filter yet — the closure had a latent hole
+        ("filter.op", "repl.sessions"),
+        # ...counts repl_log_truncations via Metrics.count (obs.metrics
+        # lock) while the op lock is still held
         ("filter.op", "obs.metrics"),
-        ("service.registry", "obs.metrics"),
+        # notify_inserts/trigger take the trigger lock at the handler
+        # commit point, under the filter's op lock
+        ("filter.op", "ckpt.trigger"),
+        # first insert/query on a filter may build the native key-pack
+        # extension (utils.packing -> native.build cache) under the op
+        # lock
+        ("filter.op", "native.build"),
+        # -- counters under commit/bookkeeping locks (each one a
+        #    deliberate "incr while held" site, not a blanket allowance)
+        # handlers count keys/dedup hits + log-append errors while the
+        # op lock is held
         ("filter.op", "obs.counters"),
+        # create/drop count filters_created etc. inside the registry
         ("service.registry", "obs.counters"),
+        # registry-held walks (gauge_snapshot) file per-filter gauges
+        ("service.registry", "obs.metrics"),
+        # OpLog._update_gauges_locked sets repl_log_* gauges inside the
+        # log condition on every append/truncate
         ("repl.oplog", "obs.counters"),
-        ("ckpt.trigger", "obs.counters"),
-        ("ckpt.redis_sink", "obs.counters"),
+        # shed/admission accounting inside the admit lock
         ("service.admit", "obs.counters"),
-        ("service.dedup", "obs.counters"),
+        # Metrics methods (count/observe/snapshot) read global counters
+        # while holding the metrics registry lock
         ("obs.metrics", "obs.counters"),
-        ("obs.slowlog", "obs.counters"),
-        ("faults.registry", "obs.counters"),
+        # the client breaker counts state flips inside its lock
         ("client.breaker", "obs.counters"),
+        # topology adoption counts pushes/refreshes under client.topology
         ("client.topology", "obs.counters"),
+        # wait_acked maintains the wait_blocked_current gauge inside the
+        # sessions condition (PR 5)
         ("repl.sessions", "obs.counters"),
-        ("repl.monitor_hub", "obs.counters"),
-        ("repl.ack_sender", "obs.counters"),
+        # repl_ack_stream_reopened incremented under the applier's call
+        # lock when the ack stream is found broken (PR 5)
         ("repl.applier_call", "obs.counters"),
+        # sentinel SDOWN/vote/failover accounting under sentinel.state
         ("sentinel.state", "obs.counters"),
-        ("sentinel.topo_events", "obs.counters"),
+        # slot-ownership gauges set inside cluster.state (PR 9)
         ("cluster.state", "obs.counters"),
-        ("cluster.client", "obs.counters"),
-        # fault points fire inside commit sections
+        # parked-request gauge + coalesce counters inside the queue
+        # condition (PR 10)
+        ("ingest.queue", "obs.counters"),
+        # -- fault points firing inside commit sections (the fire()
+        #    armed-path takes faults.registry to consume the policy
+        #    budget; reachable whenever a point is armed under a held
+        #    commit lock — chaos suites do exactly that)
+        # shard.*/ingest fault points fire under the filter op lock
         ("filter.op", "faults.registry"),
-        ("service.registry", "faults.registry"),
+        # OpLog.append fires repl.append inside the log condition
         ("repl.oplog", "faults.registry"),
-        ("repl.applier_call", "faults.registry"),
-        ("repl.ack_sender", "faults.registry"),
-        # replication: the applier serializes its call/ack plumbing, and
-        # record apply walks the normal commit locks
+        # registry-held appends (_log_create, DropFilter) transit the
+        # same repl.append firing with the registry still held
+        ("service.registry", "faults.registry"),
+        # -- replication plumbing (PR 5): the applier's call lock
+        #    guards the stream/ack HANDLES (records apply outside it)
+        # opening/closing an _AckSender under the call lock touches the
+        # ack sender's coalescing condition
         ("repl.applier_call", "repl.ack_sender"),
-        ("repl.applier_call", "repl.oplog"),
-        ("repl.applier_call", "filter.op"),
-        ("repl.applier_call", "service.registry"),
-        ("repl.applier_call", "ckpt.trigger"),
-        ("repl.applier_call", "obs.counters"),
-        # promotion / demotion re-plumb the service under the promote
-        # lock (PR 4)
+        # -- promotion / demotion re-plumb the service under the
+        #    promote lock (PR 4)
+        # rebuild_manifest + epoch adoption walk the registry
         ("service.promote", "service.registry"),
+        # become_replica's take-every-lock write fence
         ("service.promote", "filter.op"),
+        # op-log adoption (OpLog open/set_alias) under the promote lock
         ("service.promote", "repl.oplog"),
-        ("service.promote", "repl.sessions"),
+        # demotion stops / promotion starts the applier (its call lock)
         ("service.promote", "repl.applier_call"),
+        # ...and the applier teardown closes the ack sender
         ("service.promote", "repl.ack_sender"),
-        ("service.promote", "ckpt.trigger"),
+        # role transitions count promotions/demotions while still
+        # holding the promote lock
         ("service.promote", "obs.counters"),
-        # become_replica counts ha_demotions while still holding the
-        # promote lock (pre-existing; first DIFFED by test_ingest's
-        # in-process demotion test — test_ha demotes subprocesses)
+        # become_replica counts ha_demotions through Metrics (the
+        # obs.metrics lock) while still holding the promote lock
+        # (pre-existing; first DIFFED by test_ingest's in-process
+        # demotion test — test_ha demotes subprocesses)
         ("service.promote", "obs.metrics"),
-        ("service.promote", "faults.registry"),
-        # primary-side streaming reads sessions + log state
-        ("repl.sessions", "repl.oplog"),
-        ("repl.oplog", "obs.metrics"),
+        # the demotion barrier drains parked coalesced writes under the
+        # promote lock (become_replica — see ingest.drain_parked, which
+        # deliberately POLLS instead of waiting on the condition)
+        ("service.promote", "ingest.queue"),
         # -- cluster mode (ISSUE 9): the migration driver snapshots
         #    under the filter lock and arms the dual-write there;
         #    cluster.state itself is a LEAF apart from gauge updates —
-        #    node→node RPCs always run outside it
-        ("filter.op", "cluster.state"),
-        ("service.registry", "cluster.state"),
-        ("cluster.client", "client.breaker"),
-        # -- ingestion coalescer (ISSUE 10): the queue condition is a
-        #    LEAF apart from the parked-keys gauge — the dispatcher
-        #    drops it before touching any filter/registry/log lock, and
-        #    the flush itself mints only the existing filter.op edges.
+        #    node→node RPCs always run outside it.
         #    ISSUE 11 (sharded filters through the coalescer) adds NO
         #    new edges by design: the per-shard chaos surface is fault
         #    POINTS (shard.*), not locks — the staged launches fire
         #    them under the existing filter.op -> faults.registry edge,
         #    and the replicated H2D staging is lock-free (verified by
         #    the armed test_ingest module's manifest diff)
-        ("ingest.queue", "obs.counters"),
-        # the demotion barrier drains parked coalesced writes under the
-        # promote lock (become_replica — see ingest.drain_parked, which
-        # deliberately POLLS instead of waiting on the condition)
-        ("service.promote", "ingest.queue"),
+        ("filter.op", "cluster.state"),
     }
 )
 

@@ -6,8 +6,11 @@ in :func:`phase` spans. Phases accumulate on the innermost active
 context; with no context active a span is a no-op ``yield``, so the
 library hot path outside the server pays one truthy check per span.
 
-Phase vocabulary (keep to these names so dashboards line up across the
-server, ``bench.py``, and the slowlog):
+Phase vocabulary (DECLARED in :data:`tpubloom.obs.names.PHASES` /
+:data:`tpubloom.obs.names.PHASE_DYNAMIC_PREFIXES` — the lint's
+``phase-registry`` check closes both directions, so a name used here
+but not declared there, or declared but never emitted, fails CI; the
+semantics stay documented in this module):
 
 * ``decode``    — wire bytes -> request dict (msgpack)
 * ``host_prep`` — key packing + batch padding on the host

@@ -10,8 +10,8 @@ them under ``process_counters``.
 
 from __future__ import annotations
 
-import threading
 from collections import defaultdict
+
 from tpubloom.utils import locks
 
 _lock = locks.named_lock("obs.counters")

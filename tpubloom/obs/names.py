@@ -142,6 +142,32 @@ GAUGES = (
     "wait_blocked_current",
 )
 
+#: Per-request phase spans (ISSUE 13 — the counter-registry pattern
+#: extended to the phase vocabulary). Every literal name passed to
+#: ``obs.phase(...)`` / ``ctx.add_phase(...)`` must be declared here;
+#: the lint's ``phase-registry`` check closes both directions so the
+#: slowlog, ``bench.py``'s ``e2e_phases`` tail and the per-phase
+#: latency histograms keep naming the same stages. Semantics are
+#: documented where the spans are minted: :mod:`tpubloom.obs.context`.
+PHASES = (
+    "decode",
+    "host_prep",
+    "h2d",
+    "kernel",
+    "kernel_query",
+    "d2h",
+    "encode",
+)
+
+#: Phase names minted at runtime, prefix-declared like the metric
+#: DYNAMIC_PREFIXES below: the pattern and where it comes from.
+PHASE_DYNAMIC_PREFIXES = (
+    ("kernel_shard", "per-device mesh-launch completion phases "
+     "(tpubloom.parallel.sharded, ROADMAP 1(c)) — kernel_shard<i> is "
+     "the time from fence start to device i's completion; the first "
+     "jump names the straggler"),
+)
+
 #: Shapes of names minted at runtime (not literal-checkable): the
 #: pattern, its kind, and where it comes from.
 DYNAMIC_PREFIXES = (

@@ -19,9 +19,9 @@ Entries are plain dicts (msgpack-ready for the ``SlowlogGet`` RPC).
 from __future__ import annotations
 
 import heapq
-import threading
 import time
 from typing import Optional
+
 from tpubloom.utils import locks
 
 

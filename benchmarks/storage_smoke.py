@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Multi-tenant paging smoke gate (ISSUE 14).
+
+N ≫ budget tenants round-robin through a small HBM residency budget on
+a REAL subprocess server (so the measurement includes gRPC, decode,
+hydration futures, eviction checkpoints — everything a production
+client pays):
+
+* ``--max-resident-filters 4`` serves ``N_TENANTS`` (64) tenants
+  correctly under concurrent load — every write is read back through
+  an evict/re-hydrate cycle, with a small HOT set hammered throughout
+  (it staying resident is gated indirectly: the hot worker runs ~100x
+  the cold op rate, so hot-set thrash would blow the hydrations-per-op
+  bound);
+* the warm pool is squeezed (``--storage-warm-bytes``) so a share of
+  hydrations restore from the COLD (checkpoint) tier, not just host
+  RAM;
+* gates: zero readback misses, resident count ≤ budget (Health),
+  ``storage_hydrations_total`` > 0 with the hydration-latency
+  histogram populated (Stats), and an aggregate end-to-end throughput
+  floor (``MIN_OPS_PER_SEC``, re-measured once with a doubled window
+  before failing — the cluster_smoke discipline for 2-vCPU runners).
+
+Run directly (prints one JSON line) or via tier-1
+(``tests/test_storage.py::test_storage_load_smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+N_TENANTS = 64
+BUDGET = 4
+HOT = 2  # tenants hammered continuously — must stay resident
+THREADS = 2
+ROUNDS = 1  # full cold-tenant round-robins per measured window
+
+#: aggregate end-to-end ops/sec backstop (one op = insert-1 + readback
+#: query, typically paying a hydration in this deliberate-thrash shape
+#: — 64 tenants over 2 effective residency slots; measured 2.0 on this
+#: image, floor at half). The SHARPER gate is MAX_HYDRATIONS_PER_OP:
+#: pure min-heat eviction thrashed concurrent workers' in-progress
+#: tenants at ~20 hydrations/op; the banded-LRU rank measures ~3 —
+#: a policy regression shows up there long before the wall clock.
+MIN_OPS_PER_SEC = 1.0
+MAX_HYDRATIONS_PER_OP = 8.0
+
+_SERVER_CHILD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(workdir: str, port: int) -> subprocess.Popen:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    # the perf gate must not measure the debug lock tracker (the armed
+    # chaos suites cover that surface; see multichip_load's precedent)
+    env.pop("TPUBLOOM_LOCK_CHECK", None)
+    script = os.path.join(workdir, "server_child.py")
+    with open(script, "w") as f:
+        f.write(_SERVER_CHILD)
+    return subprocess.Popen(
+        [
+            sys.executable, script, str(port),
+            os.path.join(workdir, "ckpt"),
+            "--repl-log-dir", os.path.join(workdir, "oplog"),
+            "--max-resident-filters", str(BUDGET),
+            "--storage-warm-bytes", str(64 * 1024),
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _measure(client_factory, names, hot_names, rounds) -> dict:
+    """One measured window: THREADS workers round-robin the cold set
+    (insert-1 + strict readback), one worker hammers the hot set."""
+    errors: list = []
+    misses: list = []
+    ops = [0]
+    ops_lock = threading.Lock()
+    stop = threading.Event()
+
+    def cold_worker(t):
+        try:
+            with client_factory() as c:
+                mine = names[t::THREADS]
+                for rnd in range(rounds):
+                    for n in mine:
+                        key = b"%s-r%d-t%d" % (n.encode(), rnd, t)
+                        c.insert_batch(n, [key])
+                        if not c.include_batch(n, [key])[0]:
+                            misses.append((n, key))
+                        with ops_lock:
+                            ops[0] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def hot_worker():
+        try:
+            with client_factory() as c:
+                i = 0
+                while not stop.is_set():
+                    n = hot_names[i % len(hot_names)]
+                    c.insert_batch(n, [b"hot-%d" % i])
+                    i += 1
+                    time.sleep(0.002)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=cold_worker, args=(t,)) for t in range(THREADS)
+    ]
+    ht = threading.Thread(target=hot_worker, daemon=True)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    ht.start()
+    for t in threads:
+        t.join(timeout=600)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    ht.join(timeout=10)
+    return {
+        "errors": errors,
+        "misses": len(misses),
+        "ops": ops[0],
+        "elapsed_s": round(elapsed, 3),
+        "ops_per_sec": round(ops[0] / max(elapsed, 1e-9), 1),
+    }
+
+
+def main() -> dict:
+    import tempfile
+
+    from tpubloom.server.client import BloomClient
+
+    workdir = tempfile.mkdtemp(prefix="tpubloom-storage-smoke-")
+    port = _free_port()
+    proc = _spawn(workdir, port)
+    report: dict = {"ok": False, "tenants": N_TENANTS, "budget": BUDGET}
+    try:
+        with BloomClient(f"127.0.0.1:{port}") as admin:
+            admin.wait_ready(timeout=120)
+            names = [f"sm-{i:03d}" for i in range(N_TENANTS)]
+            hot_names = [f"hot-{i}" for i in range(HOT)]
+            for n in hot_names + names:
+                admin.create_filter(n, capacity=4000, error_rate=0.01)
+
+            factory = lambda: BloomClient(f"127.0.0.1:{port}")  # noqa: E731
+            run = _measure(factory, names, hot_names, ROUNDS)
+            if not run["errors"] and run["ops_per_sec"] < MIN_OPS_PER_SEC:
+                # 2-vCPU-runner discipline: re-measure once, doubled
+                # window, before calling it a regression
+                run = _measure(factory, names, hot_names, 2 * ROUNDS)
+                run["remeasured"] = True
+            report.update(run)
+
+            health = admin.health()
+            stats = admin.stats()
+            storage = health.get("storage") or {}
+            counters = stats.get("process_counters") or {}
+            report["resident"] = storage.get("resident")
+            report["cold"] = storage.get("cold")
+            report["hydrations_total"] = counters.get(
+                "storage_hydrations_total", 0
+            )
+            report["evictions_total"] = counters.get(
+                "storage_evictions_total", 0
+            )
+            report["hydration_hist"] = stats.get("hydration") or {}
+            # NOTE on the hot set: per-tenant residency is not exposed,
+            # but the no_thrash gate below covers it — the hot worker
+            # runs ~100x the cold op rate, so hot tenants falling out
+            # of residency would blow hydrations_total far past the
+            # per-cold-op bound
+
+            gates = {
+                "no_errors": not run["errors"],
+                "no_readback_misses": run["misses"] == 0,
+                "all_ops_ran": run["ops"] >= N_TENANTS * ROUNDS,
+                "budget_held": (storage.get("resident") or 99) <= BUDGET + 1,
+                "hydrated": report["hydrations_total"] > 0,
+                "cold_tier_exercised": (storage.get("cold") or 0) > 0,
+                "hydration_hist_filled": (
+                    report["hydration_hist"].get("n", 0) > 0
+                ),
+                "throughput_floor": (
+                    run["ops_per_sec"] >= MIN_OPS_PER_SEC
+                ),
+                "no_thrash": (
+                    report["hydrations_total"]
+                    <= MAX_HYDRATIONS_PER_OP * max(run["ops"], 1)
+                ),
+            }
+            report["gates"] = gates
+            report["ok"] = all(gates.values())
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return report
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    out = main()
+    print(json.dumps(out))
+    sys.exit(0 if out["ok"] else 1)

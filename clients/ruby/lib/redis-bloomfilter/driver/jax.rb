@@ -147,8 +147,9 @@ class Redis
           resp = rpc(
             "InsertBatch",
             durability(
-              { "name" => @name, "keys" => keys.map(&:to_s),
-                "return_presence" => true }, min_replicas
+              encode_keys(
+                { "name" => @name, "return_presence" => true }, keys
+              ), min_replicas
             ),
             no_retry: true
           )
@@ -170,11 +171,11 @@ class Redis
         end
 
         def delete_batch(keys, min_replicas: nil)
+          # rides the zero-copy `fixed` encoding like inserts/queries
+          # (ISSUE 14 satellite — was the last msgpack-only key path)
           rpc(
             "DeleteBatch",
-            durability(
-              { "name" => @name, "keys" => keys.map(&:to_s) }, min_replicas
-            )
+            durability(encode_keys({ "name" => @name }, keys), min_replicas)
           )
           true
         end

@@ -131,6 +131,27 @@ class Redis
           nil
         end
 
+        # Per-hop wire-encoding discipline (ISSUE 14 satellite — the
+        # named PR-10 seam): the public batch methods encode against
+        # the CURRENT connection's negotiation, but a MOVED/CLUSTERDOWN
+        # reconnect re-sends the payload to a node that negotiated
+        # nothing. If the new connection's Health probe does not
+        # advertise `fixed`, demote a keys_fixed payload back to the
+        # msgpack list for the retry hop. (ask_once's one-shot raw-stub
+        # hop is not re-probed: within one fleet generation every node
+        # decodes both encodings; the probe exists for rolling-upgrade
+        # mixes, which the owner-map path above covers.)
+        def demote_fixed(payload)
+          fx = payload["keys_fixed"]
+          return payload unless fx && !fixed_negotiated?
+          data = fx["data"]
+          width = fx["width"]
+          keys = (0...fx["n"]).map { |i| data.byteslice(i * width, width) }
+          payload = payload.reject { |k, _| k == "keys_fixed" }
+          payload["keys"] = keys
+          payload
+        end
+
         # Layer the cluster redirects over Jax#rpc's retry machinery
         # (shed pacing, UNAVAILABLE backoff, NOT_FOUND heal all apply
         # per target node).
@@ -149,6 +170,7 @@ class Redis
               raise if redirects >= 5
               redirects += 1
               connect(e.details["addr"] || resolve_owner)
+              payload = demote_fixed(payload)
               retry
             when "ASK"
               ask_once(method, payload, e.details["addr"])
@@ -157,6 +179,7 @@ class Redis
               redirects += 1
               owner = resolve_owner
               connect(owner) if owner
+              payload = demote_fixed(payload)
               sleep(0.1 * redirects)
               retry
             when "MIGRATE_FORWARD_FAILED"

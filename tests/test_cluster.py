@@ -276,6 +276,41 @@ def test_cluster_client_routing_and_moved_heal(tmp_path):
         _teardown(a, b)
 
 
+def test_cluster_client_ships_keys_fixed_per_hop(tmp_path, monkeypatch):
+    """ISSUE 14 satellite (the named PR-10 seam): the cluster client's
+    keyed batches ride the zero-copy ``keys_fixed`` encoding through
+    the per-shard connections — encoded per HOP under that shard
+    client's own negotiation, for inserts, queries AND deletes."""
+    from tpubloom.server.client import BloomClient
+
+    a = _node(tmp_path, "a")
+    b = _node(tmp_path, "b")
+    try:
+        addrs = _assign_even((a, b))
+        seen: list = []
+        orig = BloomClient._rpc
+
+        def spy(self, method, req, **kw):
+            if method in ("InsertBatch", "QueryBatch", "DeleteBatch"):
+                seen.append((method, "keys_fixed" in req))
+            return orig(self, method, req, **kw)
+
+        monkeypatch.setattr(BloomClient, "_rpc", spy)
+        cc = ClusterClient(startup_nodes=addrs)
+        name = _name_owned_by(a[0].cluster.owner, addrs[0], prefix="fx")
+        cc.create_filter(name, capacity=2000, error_rate=0.01, counting=True)
+        keys = [b"fx-%05d" % i for i in range(16)]  # equal-width batch
+        assert cc.insert_batch(name, keys) == 16
+        assert cc.include_batch(name, keys).all()
+        assert cc.delete_batch(name, keys) == 16
+        assert not cc.include_batch(name, keys).any()
+        fixed = {m for m, fx in seen if fx}
+        assert fixed == {"InsertBatch", "QueryBatch", "DeleteBatch"}, seen
+        cc.close()
+    finally:
+        _teardown(a, b)
+
+
 # -- live migration ----------------------------------------------------------
 
 

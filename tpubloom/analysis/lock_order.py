@@ -176,6 +176,33 @@ ALLOWED_EDGES = frozenset(
         # promote lock (become_replica — see ingest.drain_parked, which
         # deliberately POLLS instead of waiting on the condition)
         ("service.promote", "ingest.queue"),
+        # -- storage tier (ISSUE 14): the residency manager's
+        #    bookkeeping lock is a LEAF apart from counter/gauge
+        #    updates — it is never held across a filter/registry lock,
+        #    a device launch, or blob IO (hydration waiters block on a
+        #    plain event holding nothing; the eviction critical section
+        #    reuses the pre-existing filter.op -> service.registry
+        #    unpublish edge)
+        # gauge/counter updates inside _update_gauges_locked /
+        # _trim_warm_locked run under storage.state
+        ("storage.state", "obs.counters"),
+        # the checkpoint-keyed truncation sweep (under the committing
+        # filter's op lock, see filter.op -> service.registry above)
+        # reads the PAGED tenants' durable floor too
+        ("filter.op", "storage.state"),
+        # _create/_drop re-check "does the storage tier still know this
+        # tenant" UNDER the registry lock (the hydrate-then-create
+        # TOCTOU guard: an eviction between the caller's hydrate and
+        # this lock must retry, not rebuild fresh over paged state).
+        # Cycle-free: storage code never acquires the registry while
+        # holding storage.state (publishes/unpublishes happen outside
+        # its bookkeeping lock)
+        ("service.registry", "storage.state"),
+        # become_replica's demotion barrier drains in-flight
+        # hydrations/evictions (drain_busy — polls on purpose), the
+        # promotion path folds paged tenants into rebuild_manifest and
+        # the adopted-seq computation — all under service.promote
+        ("service.promote", "storage.state"),
         # -- cluster mode (ISSUE 9): the migration driver snapshots
         #    under the filter lock and arms the dual-write there;
         #    cluster.state itself is a LEAF apart from gauge updates —

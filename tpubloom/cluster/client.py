@@ -155,13 +155,35 @@ class ClusterClient:
             )
         return addr
 
+    @staticmethod
+    def _hop_req(client: BloomClient, req: dict, keys, extra=None) -> dict:
+        """One hop's request under the TARGET connection's negotiated
+        encoding (ISSUE 14 satellite — the named PR-10 seam): key
+        batches ride the per-shard ``BloomClient``'s zero-copy
+        ``keys_fixed`` path when that shard's Health advertised it,
+        falling back to the msgpack list per connection. Encoding per
+        HOP matters: redirect targets negotiate independently."""
+        r = dict(req)
+        if keys is not None:
+            r = client._encode_keys(r, keys)
+        if extra:
+            r.update(extra)
+        return r
+
     def _keyed(
-        self, method: str, req: dict, *, rid: Optional[str] = None
+        self,
+        method: str,
+        req: dict,
+        *,
+        rid: Optional[str] = None,
+        keys=None,
     ) -> dict:
         """Route one keyed request by its filter name, healing
         MOVED/ASK/CLUSTERDOWN along the way. One logical call = one rid
         across every redirect hop and re-drive (so a hop that applied
-        before failing answers its replay from the dedup cache)."""
+        before failing answers its replay from the dedup cache).
+        ``keys`` (raw, unencoded) are folded into each hop's request
+        under that hop's negotiated wire encoding."""
         from tpubloom.obs.context import new_rid
 
         rid = rid or new_rid()
@@ -175,7 +197,9 @@ class ClusterClient:
                 # server-sent one, not abort the whole budget
                 addr = self._owner_addr(slot)
                 client = self._client_for(addr)
-                return client._rpc(method, dict(req), rid=rid)
+                return client._rpc(
+                    method, self._hop_req(client, req, keys), rid=rid
+                )
             except protocol.BloomServiceError as e:
                 last = e
                 if e.code == "MOVED":
@@ -204,7 +228,9 @@ class ClusterClient:
                     obs_counters.incr("client_ask_redirects")
                     target = self._client_for(e.details["addr"])
                     return target._rpc(
-                        method, {**req, "asking": True}, rid=rid
+                        method,
+                        self._hop_req(target, req, keys, {"asking": True}),
+                        rid=rid,
                     )
                 if e.code == "CLUSTERDOWN":
                     self.refresh_slots()
@@ -218,7 +244,8 @@ class ClusterClient:
                     # dedup cache / idempotent apply and forwards again;
                     # the target's seq gate keeps it exactly-once
                     return self._redrive(
-                        client, method, req, rid, e.details.get("src_seq")
+                        client, method, req, rid, e.details.get("src_seq"),
+                        keys=keys,
                     )
                 raise
         if last is None:  # pragma: no cover — every continue sets last
@@ -235,6 +262,8 @@ class ClusterClient:
         req: dict,
         rid: str,
         src_seq=None,
+        *,
+        keys=None,
     ) -> dict:
         # the rid comes from the enclosing _keyed call, NOT from
         # client.last_rid — a concurrent call on the same shard client
@@ -250,7 +279,9 @@ class ClusterClient:
         for i in range(30):
             time.sleep(min(1.0, 0.05 * (i + 1)))
             try:
-                return client._call_once(method, {**req, "rid": rid})
+                return client._call_once(
+                    method, self._hop_req(client, req, keys, {"rid": rid})
+                )
             except protocol.BloomServiceError as e:
                 last = e
                 if e.code == "MIGRATE_FORWARD_FAILED":
@@ -262,7 +293,9 @@ class ClusterClient:
                     # rid + src_seq on the new owner (its gate/dedup
                     # absorbs a record that already made it across)
                     target = self._client_for(e.details["addr"])
-                    follow = {**req, "rid": rid, "asking": True}
+                    follow = self._hop_req(
+                        target, req, keys, {"rid": rid, "asking": True}
+                    )
                     if src_seq is not None:
                         follow["src_seq"] = int(src_seq)
                     return target._call_once(method, follow)
@@ -317,13 +350,12 @@ class ClusterClient:
         min_replicas_timeout_ms: Optional[int] = None,
     ):
         req = self._durability(
-            {"name": name, "keys": BloomClient._keys(keys)},
-            min_replicas, min_replicas_timeout_ms,
+            {"name": name}, min_replicas, min_replicas_timeout_ms
         )
         if not return_presence:
-            return self._keyed("InsertBatch", req)["n"]
+            return self._keyed("InsertBatch", req, keys=keys)["n"]
         req["return_presence"] = True
-        resp = self._keyed("InsertBatch", req)
+        resp = self._keyed("InsertBatch", req, keys=keys)
         if resp.get("migrate_dup") and "presence" not in resp:
             # the write landed exactly once, but this hop was absorbed
             # by the new owner's import gate and the pre-batch presence
@@ -338,9 +370,7 @@ class ClusterClient:
         return BloomClient._unpack_bool(resp, "presence")
 
     def include_batch(self, name: str, keys):
-        resp = self._keyed(
-            "QueryBatch", {"name": name, "keys": BloomClient._keys(keys)}
-        )
+        resp = self._keyed("QueryBatch", {"name": name}, keys=keys)
         return BloomClient._unpack_bool(resp, "hits")
 
     def delete_batch(
@@ -352,10 +382,9 @@ class ClusterClient:
         min_replicas_timeout_ms: Optional[int] = None,
     ) -> int:
         req = self._durability(
-            {"name": name, "keys": BloomClient._keys(keys)},
-            min_replicas, min_replicas_timeout_ms,
+            {"name": name}, min_replicas, min_replicas_timeout_ms
         )
-        return self._keyed("DeleteBatch", req)["n"]
+        return self._keyed("DeleteBatch", req, keys=keys)["n"]
 
     def insert(self, name: str, key) -> None:
         self.insert_batch(name, [key])

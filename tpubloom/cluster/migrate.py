@@ -114,9 +114,13 @@ def migrate_slot(service, slot: int, target: str) -> dict:
          "epoch": cluster.epoch()},
     )
     with service._lock:
-        names = sorted(
-            n for n in service._filters if slots_mod.key_slot(n) == slot
-        )
+        tenants = set(service._filters)
+    if service.storage is not None:
+        # paged tenants (ISSUE 14) belong to the slot too — an evicted
+        # filter that silently stayed behind would be unreachable the
+        # moment the slot finalizes at the new owner
+        tenants.update(service.storage.names())
+    names = sorted(n for n in tenants if slots_mod.key_slot(n) == slot)
     stats = {"snapshots": 0, "tail_records": 0}
     for name in names:
         _migrate_filter(service, name, target, stats)
@@ -172,7 +176,13 @@ def _migrate_filter(service, name: str, target: str, stats: dict) -> None:
         base = probe.get("have")
     except (grpc.RpcError, protocol.BloomServiceError):
         base = None
-    mf = service._filters.get(name)
+    # storage-aware lookup (ISSUE 14, control plane — never quota-shed):
+    # a paged tenant hydrates for its handoff — the snapshot-under-op-
+    # lock + dual-write arming below need the live filter. (Hydrate-on-
+    # MOVED — handing off the checkpoint POINTER for a COLD tenant
+    # instead of streaming the blob — is the documented stretch, not
+    # built yet.)
+    mf = service._resident(name)
     if mf is None:
         return  # dropped concurrently — nothing to move
     oplog = service.oplog

@@ -38,6 +38,11 @@ Fault points (the stable vocabulary; :data:`KNOWN_POINTS`):
   request parks (nothing applied — retry-safe) (ISSUE 10)
 * ``ingest.flush``        — in the ingest dispatcher before a coalesced
   flush applies (ditto; every parked request in the flush errors)
+* ``storage.evict``       — in the residency manager before an eviction
+  takes the victim's lock; a firing ABORTS the eviction cleanly — the
+  tenant stays resident and serving (ISSUE 14)
+* ``storage.hydrate``     — before a paged tenant's hydration restores;
+  nothing published — the faulted request errors, a retry re-hydrates
 * ``shard.insert`` / ``shard.query`` / ``shard.delete`` — per-shard
   points in :class:`tpubloom.parallel.sharded.ShardedBloomFilter`:
   fired once per shard the batch routes to, with ``shard=<index>``
@@ -109,6 +114,8 @@ KNOWN_POINTS = {
     "cluster.migrate_apply",
     "ingest.coalesce",
     "ingest.flush",
+    "storage.evict",
+    "storage.hydrate",
     "shard.insert",
     "shard.query",
     "shard.delete",

@@ -88,12 +88,25 @@ def promote_to_primary(service, *, repl_log_dir=None, epoch=None) -> dict:
         if applier is not None:
             upstream_id = applier.log_id
             applier.stop()
+        if service.storage is not None:
+            # ISSUE 14: a tenant mid-eviction is in NEITHER tier — not
+            # in the registry (unpublished) and its storage entry's
+            # applied_seq/create_req not yet filed — so the adopted-seq
+            # max below and rebuild_manifest would both miss it. Settle
+            # in-flight transitions first (same discipline as
+            # become_replica's demotion barrier).
+            service.storage.drain_busy()
         with service._lock:
             mfs = list(service._filters.values())
         adopted = max(
             [applier.cursor or 0 if applier is not None else 0]
             + [mf.applied_seq for mf in mfs]
             + [service.oplog.last_seq if service.oplog is not None else 0]
+            # paged tenants' history counts too (ISSUE 14): a bare
+            # replica's fresh log must not mint seqs below an evicted
+            # tenant's applied state
+            + [service.storage.max_applied_seq()
+               if service.storage is not None else 0]
         )
 
         if service.oplog is None:
@@ -193,6 +206,16 @@ def become_replica(service, primary_address: str, *, epoch=None) -> dict:
             # acked write is in the log. Only THEN may the applier take
             # the log over (reappend preserves the upstream seq space;
             # a handler appending after that would mint a conflict).
+            if service.storage is not None:
+                # ISSUE 14: a write that passed the READONLY check may
+                # still be WAITING on a tenant hydration — its filter
+                # lock does not exist yet, so the take-every-lock
+                # barrier below cannot cover it. Settle in-flight
+                # hydrations/evictions first; the straggler then hits
+                # the write-side fence re-check under the op lock
+                # (service._op) and bounces READONLY instead of
+                # applying unlogged.
+                service.storage.drain_busy()
             with service._lock:
                 mfs = list(service._filters.values())
             for mf in mfs:

@@ -240,6 +240,15 @@ def render_service(service, *, exemplars: bool = False) -> str:
             bounds,
             "Time spent blocked on replica acks (commit barrier + Wait)",
         )
+    hydrations = met.get("hydrations")
+    if hydrations and hydrations.get("n"):
+        _render_histogram(
+            out,
+            "storage_hydration_seconds",
+            [({}, hydrations)],
+            bounds,
+            "Tenant hydration latency (storage paging fault, ISSUE 14)",
+        )
 
     gauge_headers_done: set[str] = set()
 

@@ -115,6 +115,10 @@ COUNTERS = (
     "sentinel_topology_pushes",
     "sentinel_votes_granted",
     "stale_epoch_rejected",
+    "storage_evictions_total",
+    "storage_hydrations_shed",
+    "storage_hydrations_total",
+    "storage_warm_demotions",
 )
 
 #: Last-write-wins levels (rendered as Prometheus ``gauge``).
@@ -139,6 +143,11 @@ GAUGES = (
     "sentinel_known_replicas",
     "sentinel_last_election_votes",
     "sentinel_sdown",
+    "storage_cold_filters",
+    "storage_resident_bytes",
+    "storage_resident_filters",
+    "storage_warm_bytes",
+    "storage_warm_filters",
     "wait_blocked_current",
 )
 

@@ -76,6 +76,20 @@ from tpubloom.utils import locks
 log = logging.getLogger("tpubloom.server")
 
 
+class _EvictedRace(Exception):
+    """The flush's resolved ``_Managed`` was paged out between lookup
+    and lock (ISSUE 14) — the dispatcher re-resolves (hydrating if
+    needed) and retries the flush against the live filter."""
+
+
+def _check_live(mf) -> None:
+    """First statement under every flush's op lock: a flag set means
+    the storage tier evicted this object — mutating it would write to
+    detached device arrays the eviction blob missed."""
+    if getattr(mf, "evicted", False):
+        raise _EvictedRace
+
+
 class CoalesceConfig:
     """Flush policy knobs. A group flushes when its parked keys reach
     ``max_keys``, its parked payload reaches ``max_bytes``, or its
@@ -407,17 +421,18 @@ class IngestCoalescer:
         service.metrics.count("ingest_requests_coalesced", len(entries))
         total_keys = sum(e.nkeys for e in entries)
         service.metrics.count("ingest_keys_coalesced", total_keys)
-        if kind == "query":
-            service.metrics.count("ingest_query_flushes")
-            self._flush_query(mf, entries)
-            return
-        if kind == "delete":
-            service.metrics.count("ingest_delete_flushes")
-            self._flush_delete(name, mf, entries)
-            return
-        if kind == "clear":
-            service.metrics.count("ingest_clear_flushes")
-            self._flush_clear(name, mf, entries)
+        if kind in ("query", "delete", "clear"):
+            if kind == "query":
+                service.metrics.count("ingest_query_flushes")
+            elif kind == "delete":
+                service.metrics.count("ingest_delete_flushes")
+            else:
+                service.metrics.count("ingest_clear_flushes")
+            self._retry_evicted(name, mf, {
+                "query": lambda m: self._flush_query(m, entries),
+                "delete": lambda m: self._flush_delete(name, m, entries),
+                "clear": lambda m: self._flush_clear(name, m, entries),
+            }[kind])
             return
         # op-sorted flushes (ISSUE 11 satellite): ONE presence-wanting
         # request used to drag every flush-mate through the fused
@@ -450,7 +465,10 @@ class IngestCoalescer:
             # write invites a fresh-rid client retry = double apply).
             # Each part owns exactly its own waiters.
             try:
-                self._flush_insert(name, mf, part)
+                self._retry_evicted(
+                    name, mf,
+                    lambda m: self._flush_insert(name, m, part),
+                )
             except BaseException as e:  # noqa: BLE001 — waiters must wake
                 log.exception("ingest flush part for %r failed", name)
                 err = (
@@ -462,6 +480,23 @@ class IngestCoalescer:
                 for entry in part:
                     if not entry.event.is_set():
                         entry.complete(error=err)
+
+    def _retry_evicted(self, name: str, mf, fn):
+        """Run one flush body, re-resolving across eviction races
+        (ISSUE 14): ``_check_live`` raises FIRST under every flush's op
+        lock, before anything applies, so the retry is clean — the
+        re-resolve hydrates the live filter and the body re-runs."""
+        from tpubloom.server import protocol
+
+        for _ in range(4):
+            try:
+                return fn(mf)
+            except _EvictedRace:
+                mf = self._service._get(name)
+        raise protocol.BloomServiceError(
+            "INTERNAL",
+            f"flush for {name!r} kept racing evictions — giving up",
+        )
 
     @staticmethod
     def _demote_wide_rows(mf, rows, keys):
@@ -502,6 +537,7 @@ class IngestCoalescer:
         if self._service._staged_ok(mf):
             staged = mf.filter.stage_batch(keys, rows=rows)
         with mf.lock:
+            _check_live(mf)
             if staged is not None:
                 hits_dev, _ = mf.filter.launch_query(staged)
                 hits = np.asarray(hits_dev)  # fence + D2H
@@ -538,6 +574,7 @@ class IngestCoalescer:
         self._settle(*self._inflight.take())
         presence = None
         with mf.lock:
+            _check_live(mf)
             if service.cluster is not None and (
                 service.cluster.forward_target(name) is not None
             ):
@@ -617,6 +654,7 @@ class IngestCoalescer:
         # must fail the INSERT's waiters, not surface as this delete's
         self._settle(*self._inflight.take())
         with mf.lock:
+            _check_live(mf)
             if service.cluster is not None and (
                 service.cluster.forward_target(name) is not None
             ):
@@ -655,6 +693,7 @@ class IngestCoalescer:
         service = self._service
         self._settle(*self._inflight.take())  # see _flush_delete
         with mf.lock:
+            _check_live(mf)
             if service.cluster is not None and (
                 service.cluster.forward_target(name) is not None
             ):

@@ -107,6 +107,11 @@ class Metrics:
         #: (ISSUE 5): both the per-write commit barrier and the Wait RPC
         #: observe here — the latency cost of the durability knob
         self.waits = LatencyHistogram()
+        #: tenant hydration latency (ISSUE 14): how long a paging fault
+        #: takes to restore a WARM/COLD filter to device — the cost of
+        #: multiplexing more tenants than HBM holds, and the number the
+        #: --max-resident-bytes sizing runbook is calibrated against
+        self.hydrations = LatencyHistogram()
         self.started_at = time.time()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -137,6 +142,11 @@ class Metrics:
         with self._lock:
             self.waits.observe(seconds)
 
+    def observe_hydration(self, seconds: float) -> None:
+        """File one tenant hydration (storage paging fault, ISSUE 14)."""
+        with self._lock:
+            self.hydrations.observe(seconds)
+
     def snapshot(self) -> dict:
         from tpubloom.obs import counters as global_counters
 
@@ -147,6 +157,7 @@ class Metrics:
                 "latency": {k: v.summary() for k, v in self.latency.items()},
                 "phases": {k: v.summary() for k, v in self.phases.items()},
                 "wait_barrier": self.waits.summary(),
+                "hydration": self.hydrations.summary(),
                 "process_counters": global_counters.global_counters(),
             }
 
@@ -160,4 +171,5 @@ class Metrics:
                 "latency": {k: v.export() for k, v in self.latency.items()},
                 "phases": {k: v.export() for k, v in self.phases.items()},
                 "waits": self.waits.export(),
+                "hydrations": self.hydrations.export(),
             }

@@ -135,6 +135,7 @@ import threading
 import time
 from collections import OrderedDict
 from concurrent import futures
+from contextlib import contextmanager
 from typing import Optional
 
 import grpc
@@ -166,6 +167,17 @@ class _Managed:
 
         self.filter = filt
         self.lock = locks.named_lock("filter.op")
+        #: set (under ``lock``) when the storage tier evicted this
+        #: filter out of the registry (ISSUE 14): a straggler that
+        #: resolved the object before the eviction re-checks this flag
+        #: after acquiring the lock (``BloomService._op``) and
+        #: re-resolves through the hydration path instead of writing to
+        #: detached device arrays
+        self.evicted = False
+        #: durable floor this filter hydrated from (set by the storage
+        #: tier): lets a read-only residency cycle evict WITHOUT a
+        #: fresh final checkpoint — see TenantStore._evict
+        self.hydration_landed_seq = None
         #: newest op-log seq whose effect this filter's state contains —
         #: advanced at every logged commit, persisted into checkpoint
         #: headers (``repl_seq``), and used to gate replay/stream apply
@@ -219,6 +231,13 @@ RETRY_AFTER_CAP_FACTOR = 32
 #: Commit-point appends between checkpoint-keyed log-truncation sweeps.
 TRUNCATE_EVERY_APPENDS = 64
 
+
+class _TenantPagedRace(Exception):
+    """A create/drop hydrated its tenant first, but an eviction paged it
+    back out before the registry lock was taken (ISSUE 14). The caller
+    re-hydrates and retries — building a FRESH filter (or answering
+    ``existed: False``) over paged state would silently lose it."""
+
 #: Default commit-barrier / Wait budget when neither the server flag nor
 #: the request provides one (ms).
 DEFAULT_MIN_REPLICAS_MAX_LAG_MS = 1000
@@ -248,6 +267,7 @@ class BloomService:
         min_replicas_max_lag_ms: int = DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
         cluster=None,
         coalesce=None,
+        storage=None,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -378,6 +398,19 @@ class BloomService:
             from tpubloom.server.ingest import IngestCoalescer
 
             self._coalescer = IngestCoalescer(self, coalesce).start()
+        #: tiered residency manager (ISSUE 14): with a
+        #: :class:`tpubloom.storage.StorageConfig` attached, the flat
+        #: registry becomes a registry/storage pair — ``_filters`` holds
+        #: only the RESIDENT tier, cold-ranked filters are evicted under
+        #: the HBM budget into host-RAM blobs / checkpoints, and
+        #: :meth:`_get` lazily re-hydrates on first RPC. None = every
+        #: filter resident for the process lifetime (the pre-ISSUE-14
+        #: behavior, no per-request overhead).
+        self.storage = None
+        if storage is not None:
+            from tpubloom.storage import TenantStore
+
+            self.storage = TenantStore(self, storage)
 
     @property
     def draining(self) -> bool:
@@ -387,11 +420,88 @@ class BloomService:
 
     def _get(self, name: str) -> _Managed:
         mf = self._filters.get(name)
-        if mf is None:
-            raise protocol.BloomServiceError(
-                "NOT_FOUND", f"filter {name!r} does not exist"
-            )
+        if mf is not None:
+            return mf
+        if self.storage is not None:
+            # paging fault (ISSUE 14): a WARM/COLD tenant hydrates here
+            # — the caller blocks on the hydration future, so the RPC
+            # wrapper and the ingest coalescer's flush path both see
+            # either the whole filter or NOT_FOUND, never a torn one.
+            # On the replay/stream-apply path the resolve is CONTROL
+            # plane: a handler dispatched by apply_record must never be
+            # quota-shed (replication progress beats data-plane
+            # pressure), including its _op re-resolve after an eviction
+            # race.
+            mf = self.storage.resolve(name, control_plane=self._applying())
+            if mf is not None:
+                return mf
+        raise protocol.BloomServiceError(
+            "NOT_FOUND", f"filter {name!r} does not exist"
+        )
+
+    def _resident(self, name: str) -> Optional[_Managed]:
+        """Registry lookup for apply/replay/admin paths: hydrates paged
+        tenants on the CONTROL plane (no quota sheds — replication and
+        replay must make progress regardless of data-plane pressure);
+        None for unknown names."""
+        mf = self._filters.get(name)
+        if mf is None and self.storage is not None:
+            mf = self.storage.resolve(name, control_plane=True)
         return mf
+
+    def has_filter(self, name: str) -> bool:
+        """Tenant existence across BOTH tiers (resident + paged) — what
+        the cluster wrapper's ASK decision and ListFilters must see:
+        an evicted tenant still exists."""
+        return name in self._filters or (
+            self.storage is not None and self.storage.has(name)
+        )
+
+    def _applying(self) -> bool:
+        """True on the op-log replay / stream-apply path."""
+        return self._replaying or (
+            getattr(self._apply_seq_hint, "seq", None) is not None
+        )
+
+    @contextmanager
+    def _op(self, name: str, *, write: bool = False):
+        """Resolve + lock one filter, healing the lookup→evict race
+        (ISSUE 14): a handler that resolved its ``_Managed`` before a
+        concurrent eviction unpublished it would otherwise mutate
+        detached device arrays the eviction blob missed — an acked
+        write that silently vanishes. After acquiring the op lock the
+        ``evicted`` flag is re-checked and a stale object re-resolves
+        through the hydration path. ``write=True`` additionally
+        re-checks the replica write fence UNDER the lock: a write that
+        passed the wrapper's READONLY check but then waited out a
+        hydration must not apply after a demotion flipped the role
+        (the take-every-lock barrier only covers locks that exist)."""
+        while True:
+            mf = self._get(name)
+            with mf.lock:
+                if mf.evicted:
+                    continue
+                if write and self.read_only and not self._applying():
+                    raise protocol.BloomServiceError(
+                        "READONLY",
+                        f"write to {name!r} rejected: this server became "
+                        f"a read-only replica — send writes to the primary",
+                        details=(
+                            {"primary": self.primary_address}
+                            if self.primary_address
+                            else None
+                        ),
+                    )
+                yield mf
+                return
+
+    def shed_hint(self) -> int:
+        """Adaptive retry_after_ms for shed decisions taken OUTSIDE the
+        admission gate (the storage tier's hydration quotas, ISSUE 14)
+        — same pressure signal, same Health "shedding" window."""
+        with self._admit_lock:
+            self._last_shed_time = time.time()
+            return self._bump_shed_pressure()
 
     # -- admission control (overload shedding + drain) -----------------------
 
@@ -737,13 +847,8 @@ class BloomService:
         — this shard's replicas cannot rebuild the blob's bytes from
         records, so applying that record full-resyncs them (the PR-3
         machinery), which carries the installed state."""
-        filt = ckpt.restore_blob(blob)
-        config = (
-            filt.base_config if hasattr(filt, "layers") else filt.config
-        )
-        sink = self._sink_factory(config)
-        mf = _Managed(filt, sink, getattr(config, "checkpoint_every", 0))
-        create_req = self._manifest_req_for(name, filt)
+        mf = self._managed_from_blob(blob)
+        create_req = self._manifest_req_for(name, mf.filter)
         with self._lock:
             old = self._filters.pop(name, None)
             # log BEFORE publishing (same rule as CreateFilter): a
@@ -765,6 +870,9 @@ class BloomService:
             # blob's bytes exist in no record stream
             with mf.lock:
                 mf.checkpointer.trigger()
+        if self.storage is not None:
+            self.storage.note_created(name)
+            self.storage.ensure_budget()
 
     # -- replication: op log, apply, snapshots (ISSUE 3) ---------------------
 
@@ -788,6 +896,20 @@ class BloomService:
         (``None`` when nothing was logged) — what the commit barrier
         blocks on and what mutating responses echo as ``repl_seq``."""
         if self.oplog is None or self._replaying or self._stream_fed:
+            hint = getattr(self._apply_seq_hint, "seq", None)
+            if mf is not None and hint is not None:
+                # apply path (replay / stream apply): advance the
+                # filter's seq stamp HERE, under the op lock the commit
+                # runs under — a checkpoint triggered by this record's
+                # own notify_inserts must stamp it, and an eviction
+                # serialized after this lock section snapshots state
+                # that truly CONTAINS the record. (Review fix, ISSUE
+                # 14: apply_record's old lock-free pre-advance let a
+                # concurrent eviction stamp a seq whose effect was
+                # absent — a SIGKILL after that checkpoint landed
+                # would gate the record out of replay: acked write
+                # durably lost.)
+                mf.applied_seq = max(mf.applied_seq, hint)
             return None
         try:
             seq = self.oplog.append(method, req, rid=obs.current_rid())
@@ -829,6 +951,16 @@ class BloomService:
             if meta is None:
                 return  # nothing landed yet for this filter
             safe = min(safe, int(meta.get("repl_seq") or 0))
+        if self.storage is not None:
+            # paged tenants (ISSUE 14) bound GC exactly like resident
+            # ones: a WARM/COLD tenant's records past its durable
+            # checkpoint must survive a SIGKILL (its host-RAM blob does
+            # not), and one with NO durable generation pins the whole
+            # log — the same rule as an unpersisted resident filter
+            paged_floor = self.storage.truncate_floor()
+            if paged_floor is None:
+                return
+            safe = min(safe, paged_floor)
         replica_floor = self.repl_sessions.min_cursor()
         if replica_floor is not None:
             safe = min(safe, replica_floor)
@@ -868,14 +1000,20 @@ class BloomService:
                 mf.applied_seq = max(mf.applied_seq, seq)
             return True
         if method == "DropFilter":
-            mf = self._filters.get(name)
+            # hydrate-first (ISSUE 14): the NEWER-than-this-drop seq
+            # gate below must judge the real filter, not skip because
+            # the tenant happens to be paged out
+            mf = self._resident(name)
             if mf is not None and mf.applied_seq >= seq:
                 # the live filter is NEWER than this drop (a full-resync
                 # snapshot installed the re-created filter): dropping it
                 # would delete state the later records cannot rebuild
                 return False
             return bool(self.DropFilter(req).get("existed"))
-        mf = self._filters.get(name)
+        # storage-aware lookup (ISSUE 14): a record for an EVICTED
+        # tenant hydrates it first — on a replica, stream apply must
+        # land on the real state, not skip as "unknown filter"
+        mf = self._resident(name)
         if mf is None:
             log.warning(
                 "op-log record seq %d (%s) names unknown filter %r; skipped",
@@ -884,12 +1022,12 @@ class BloomService:
             return False
         if mf.applied_seq >= seq:
             return False
-        # advance BEFORE the handler runs (mirror of the live path's
-        # log-before-notify ordering): a checkpoint the handler triggers
-        # via notify_inserts must stamp THIS record's seq, or a second
-        # crash replays the record past its own checkpoint
+        # the seq stamp advances inside the handler's _log_op call,
+        # UNDER the op lock (see there) — before notify_inserts, so a
+        # checkpoint the handler triggers stamps THIS record's seq, and
+        # an eviction serialized against the same lock can never
+        # snapshot the stamp before the record's effect is applied
         prev = mf.applied_seq
-        mf.applied_seq = seq
         self._apply_seq_hint.seq = seq
         try:
             getattr(self, method)(req)
@@ -939,6 +1077,11 @@ class BloomService:
                     failed += 1
         finally:
             self._replaying = False
+        if self.storage is not None:
+            # replay forced every manifest tenant resident (records can
+            # only apply to live filters); page back down to the HBM
+            # budget ONCE now instead of thrashing per record
+            self.storage.ensure_budget()
         self.metrics.count("repl_replay_applied", applied)
         return {
             "applied": applied,
@@ -964,35 +1107,49 @@ class BloomService:
         with self._lock:
             items = list(self._filters.items())
             plan_seq = self.oplog.last_seq if self.oplog is not None else 0
+        # paged tenants (ISSUE 14) stream too — a bootstrapping replica
+        # must receive the WHOLE tenant set, and paging them in just to
+        # stream them out would churn the hot set; their loaders answer
+        # from the warm pool / the sink at send time
+        paged = (
+            self.storage.paged_plan_items(exclude={n for n, _ in items})
+            if self.storage is not None
+            else []
+        )
 
         def gen():
             for name, mf in items:
                 with mf.lock:
+                    # an mf evicted between plan and send still works:
+                    # the object is a consistent snapshot of its state
+                    # at eviction, and every later record streams from
+                    # the log tail — same story as any other filter
                     _, _, blob = ckpt.snapshot_blob(mf.filter)
                     applied_seq = mf.applied_seq
                 yield name, blob, applied_seq
+            for name, load in paged:
+                blob, applied_seq = load()
+                yield name, blob, applied_seq
 
-        return [name for name, _ in items], gen(), plan_seq
+        names = [name for name, _ in items] + [name for name, _ in paged]
+        return names, gen(), plan_seq
 
     def install_snapshot(self, name: str, blob: bytes, applied_seq: int) -> None:
         """Replica bootstrap: adopt a primary's filter snapshot wholesale
         (config comes from the blob header — the primary's config IS the
         truth), replacing any local filter of that name."""
-        filt = ckpt.restore_blob(blob)
-        config = (
-            filt.base_config if hasattr(filt, "layers") else filt.config
-        )
-        sink = self._sink_factory(config)
-        mf = _Managed(filt, sink, getattr(config, "checkpoint_every", 0))
-        mf.applied_seq = applied_seq
+        mf = self._managed_from_blob(blob, applied_seq)
         with self._lock:
             old = self._filters.pop(name, None)
             self._filters[name] = mf
             # a replica with durable state (cursor-persistence satellite)
             # must be able to restore this filter at restart too
-            self._manifest_put(name, self._manifest_req_for(name, filt))
+            self._manifest_put(name, self._manifest_req_for(name, mf.filter))
         if old is not None and old.checkpointer:
             old.checkpointer.close(final_checkpoint=False)
+        if self.storage is not None:
+            self.storage.note_created(name)
+            self.storage.ensure_budget()
         self.metrics.count("repl_snapshots_installed")
 
     def retain_only(self, names) -> None:
@@ -1010,6 +1167,72 @@ class BloomService:
         for n, mf in victims:
             if mf.checkpointer:
                 mf.checkpointer.close(final_checkpoint=False)
+        if self.storage is not None:
+            # paged tenants the primary no longer has must go too
+            self.storage.retain_only(names)
+
+    # -- storage tier: hydration builders (ISSUE 14) -------------------------
+
+    def _config_of(self, create_req: dict) -> FilterConfig:
+        """The (base) FilterConfig a manifest-shaped create request
+        describes — what the storage tier keys sinks by."""
+        req = dict(create_req)
+        name = req["name"]
+        if req.get("scalable"):
+            base, _ = self._parse_scalable(req, name)
+            return base
+        return self._parse_config(req, name)
+
+    def _managed_from_blob(self, blob: bytes, applied_seq=0) -> _Managed:
+        """Rebuild a ``_Managed`` from one snapshot blob — the blob's
+        stored config is the truth. The single recipe behind WARM
+        hydration (ISSUE 14), replica snapshot installs, and migration
+        installs."""
+        filt = ckpt.restore_blob(blob)
+        config = filt.base_config if hasattr(filt, "layers") else filt.config
+        sink = self._sink_factory(config)
+        mf = _Managed(filt, sink, getattr(config, "checkpoint_every", 0))
+        mf.applied_seq = int(applied_seq or 0)
+        return mf
+
+    def _managed_from_sink(self, name: str, create_req) -> _Managed:
+        """COLD hydration: restore the newest durable checkpoint
+        generation (the eviction path landed one stamped at the evicted
+        ``applied_seq``, so no op-log tail needs replaying here — every
+        later write hydrated first by construction)."""
+        req = dict(create_req or {})
+        req["name"] = name
+        if req.get("scalable"):
+            base, policy = self._parse_scalable(req, name)
+            sink = self._sink_factory(base)
+            restored = (
+                self._tracked_restore(
+                    name, base, sink,
+                    scalable_expect=policy, expect_scalable=True,
+                )
+                if sink is not None
+                else None
+            )
+            config = base
+        else:
+            config = self._parse_config(req, name)
+            sink = self._sink_factory(config)
+            restored = (
+                self._tracked_restore(name, config, sink, expect_scalable=False)
+                if sink is not None
+                else None
+            )
+        if restored is None:
+            raise protocol.BloomServiceError(
+                "INTERNAL",
+                f"cold tenant {name!r} has no restorable checkpoint "
+                f"generation — hydration impossible (durable tier lost?)",
+            )
+        mf = _Managed(restored, sink, config.checkpoint_every)
+        mf.applied_seq = int(
+            getattr(restored, "_restored_meta", {}).get("repl_seq", 0) or 0
+        )
+        return mf
 
     # -- RPC handlers (dict in, dict out) ------------------------------------
 
@@ -1083,6 +1306,8 @@ class BloomService:
         }
         if self.listen_address:
             resp["listen"] = self.listen_address
+        if self.storage is not None:
+            resp["storage"] = self.storage.summary()
         if self.cluster is not None:
             resp["cluster"] = self.cluster.summary()
         if self.replica_applier is not None and self.read_only:
@@ -1155,6 +1380,27 @@ class BloomService:
         return restored
 
     def CreateFilter(self, req: dict) -> dict:  # lint: allow(replay-safety): replay converges on state (a retried create finds the filter registered and never double-builds); exist_ok attaches idempotently, a bare-create retry answers EXISTS — loud, not corrupting. No per-request device state to cache
+        for _ in range(4):
+            if self.storage is not None:
+                # page a WARM/COLD tenant in FIRST (ISSUE 14): exist_ok
+                # attaches and config-mismatch checks must compare
+                # against the real filter — a bare-create over paged
+                # state would otherwise silently rebuild it empty
+                self.storage.resolve(req["name"], control_plane=True)
+            try:
+                resp = self._create(req)
+            except _TenantPagedRace:
+                continue  # evicted between hydrate and registry lock
+            if self.storage is not None and resp.get("ok"):
+                self.storage.note_created(req["name"])
+                self.storage.ensure_budget()
+            return resp
+        raise protocol.BloomServiceError(
+            "INTERNAL",
+            f"create of {req['name']!r} kept racing evictions — retry",
+        )
+
+    def _create(self, req: dict) -> dict:
         name = req["name"]
         want_scalable = bool(req.get("scalable"))
         with self._lock:
@@ -1236,6 +1482,11 @@ class BloomService:
                 raise protocol.BloomServiceError(
                     "ALREADY_EXISTS", f"filter {name!r} exists"
                 )
+            if self.storage is not None and self.storage.has(name):
+                # not in the registry, but the storage tier KNOWS the
+                # tenant: it was evicted between the caller's hydrate
+                # and this lock — never rebuild fresh over paged state
+                raise _TenantPagedRace(name)
             if want_scalable:
                 return self._create_scalable(req, name)
             config = self._parse_config(req, name)
@@ -1358,6 +1609,12 @@ class BloomService:
                 items = list(self._filters.items())
             for name, mf in items:
                 manifest[name] = self._manifest_req_for(name, mf.filter)
+            if self.storage is not None:
+                # paged tenants exist too (ISSUE 14): a promotion that
+                # dropped them from the manifest would lose them at the
+                # next restart's replay
+                for name, req in self.storage.create_reqs().items():
+                    manifest.setdefault(name, req)
 
         self._manifest_write(mutate)
 
@@ -1450,9 +1707,37 @@ class BloomService:
         return resp
 
     def DropFilter(self, req: dict) -> dict:  # lint: allow(replay-safety): replay converges — a retried drop of the now-missing name answers {existed: False}, which clients already treat as success (drop of missing is a no-op by contract)
+        for _ in range(4):
+            if self.storage is not None:
+                # page in first (ISSUE 14): the drop must log + take its
+                # final checkpoint over the REAL state, and a paged
+                # tenant must not answer {existed: False}
+                self.storage.resolve(req["name"], control_plane=True)
+            try:
+                # the storage entry is forgotten INSIDE _drop's registry
+                # critical section — forgetting after the lock released
+                # would race a concurrent re-create of the same name and
+                # delete the NEW tenant's entry
+                return self._drop(req)
+            except _TenantPagedRace:
+                continue  # evicted between hydrate and registry lock
+        raise protocol.BloomServiceError(
+            "INTERNAL",
+            f"drop of {req['name']!r} kept racing evictions — retry",
+        )
+
+    def _drop(self, req: dict) -> dict:
         seq = None
         with self._lock:
             mf = self._filters.pop(req["name"], None)
+            if (
+                mf is None
+                and self.storage is not None
+                and self.storage.has(req["name"])
+            ):
+                # evicted between the caller's hydrate and this lock —
+                # a paged tenant must not answer {existed: False}
+                raise _TenantPagedRace(req["name"])
             if mf is not None:
                 # inside the lock: a concurrent CreateFilter of the same
                 # name must not log its create before this drop
@@ -1465,6 +1750,10 @@ class BloomService:
                     may_truncate=False,
                 )
                 self._manifest_remove(req["name"])
+                if self.storage is not None:
+                    # under the registry lock — a re-create of the same
+                    # name serializes AFTER this forget (see DropFilter)
+                    self.storage.forget(req["name"])
         if mf is None:
             return {"ok": True, "existed": False}
         if mf.checkpointer:
@@ -1486,7 +1775,11 @@ class BloomService:
 
     def ListFilters(self, req: dict) -> dict:
         with self._lock:
-            return {"ok": True, "filters": sorted(self._filters)}
+            names = set(self._filters)
+        if self.storage is not None:
+            # evicted tenants still exist — paging is transparent
+            names.update(self.storage.names())
+        return {"ok": True, "filters": sorted(names)}
 
     # -- keyed-batch helpers: fixed wire encoding + coalescing (ISSUE 10) ----
 
@@ -1600,7 +1893,7 @@ class BloomService:
             # coalescer stopped between the check and the park — direct
         nkeys = protocol.batch_size(req)
         rows = self._fixed_rows(req)
-        with mf.lock, tracing.request_span(
+        with self._op(req["name"], write=True) as mf, tracing.request_span(
             "InsertBatch", batch=nkeys, rid=obs.current_rid()
         ):
             presence = None
@@ -1652,7 +1945,7 @@ class BloomService:
                 return resp
         nkeys = protocol.batch_size(req)
         rows = self._fixed_rows(req)
-        with mf.lock, tracing.request_span(
+        with self._op(req["name"]) as mf, tracing.request_span(
             "QueryBatch", batch=nkeys, rid=obs.current_rid()
         ):
             # see class docstring: donation makes the lock mandatory
@@ -1719,7 +2012,7 @@ class BloomService:
             if resp is not None:
                 return resp
         nkeys = protocol.batch_size(req)
-        with mf.lock:
+        with self._op(req["name"], write=True) as mf:
             mf.filter.delete_batch(self._keys_list(req))
             seq = self._log_op(
                 "DeleteBatch", {"name": req["name"], **self._op_keys(req)}, mf
@@ -1739,7 +2032,7 @@ class BloomService:
             resp = self._coalescer.submit("Clear", req)
             if resp is not None:
                 return resp
-        with mf.lock:
+        with self._op(req["name"], write=True) as mf:
             mf.filter.clear()
             seq = self._log_op("Clear", {"name": req["name"]}, mf)
         resp = {"ok": True}
@@ -1749,8 +2042,7 @@ class BloomService:
 
     def Stats(self, req: dict) -> dict:
         if "name" in req:
-            mf = self._get(req["name"])
-            with mf.lock:
+            with self._op(req["name"]) as mf:
                 st = mf.filter.stats() if hasattr(mf.filter, "stats") else {}
             if mf.checkpointer:
                 st["checkpoints_written"] = mf.checkpointer.checkpoints_written
@@ -1783,6 +2075,8 @@ class BloomService:
         out = []
         for name, mf in filters:
             with mf.lock:
+                if mf.evicted:
+                    continue  # paged out mid-walk — no device gauges
                 st = mf.filter.stats() if hasattr(mf.filter, "stats") else {}
                 # sharded stats() already paid the per-shard popcount —
                 # don't run the O(m) reduction twice under the op lock
@@ -1802,12 +2096,12 @@ class BloomService:
         return out
 
     def Checkpoint(self, req: dict) -> dict:
-        mf = self._get(req["name"])
-        if not mf.checkpointer:
-            raise protocol.BloomServiceError(
-                "UNSUPPORTED", "filter has no checkpoint sink"
-            )
-        with mf.lock:  # snapshot copy must not race a donating insert
+        with self._op(req["name"]) as mf:
+            # snapshot copy must not race a donating insert
+            if not mf.checkpointer:
+                raise protocol.BloomServiceError(
+                    "UNSUPPORTED", "filter has no checkpoint sink"
+                )
             triggered = mf.checkpointer.trigger()
         if req.get("wait", True):
             if not mf.checkpointer.flush():
@@ -1904,6 +2198,11 @@ def _wrap(service: BloomService, method_name: str):
                     rctx.summary = summarize_request(method_name, req)
                     name = req.get("name")
                     req_name = name if isinstance(name, str) else None
+                    if service.storage is not None and req_name is not None:
+                        # key-weighted tenant heat (ISSUE 14) — the
+                        # eviction rank follows the same load signal
+                        # the per-slot traffic counters expose
+                        service.storage.touch(req_name, rctx.batch or 1)
                     # topology-epoch fence (ISSUE 4): a mutating request
                     # stamped with an OLDER epoch than this server's was
                     # routed under a pre-failover view — reject so the
@@ -1937,7 +2236,7 @@ def _wrap(service: BloomService, method_name: str):
                         service.cluster.check(
                             req_name,
                             asking=bool(req.get("asking")),
-                            exists=req_name in service._filters,
+                            exists=service.has_filter(req_name),
                             primary_address=(
                                 service.primary_address
                                 if service.read_only
@@ -2388,6 +2687,54 @@ def main(argv: Optional[list] = None) -> None:
         "longer than this for batch-mates (default 500us)",
     )
     parser.add_argument(
+        "--max-resident-filters",
+        type=int,
+        default=0,
+        metavar="N",
+        help="multi-tenant paging (ISSUE 14): keep at most N filters "
+        "RESIDENT in device HBM; cold-ranked filters are evicted to a "
+        "host-RAM blob pool (and their checkpoints) and lazily "
+        "re-hydrated on first RPC. 0 disables paging (the default, "
+        "every filter resident for the process lifetime)",
+    )
+    parser.add_argument(
+        "--max-resident-bytes",
+        type=int,
+        default=0,
+        metavar="B",
+        help="HBM residency budget in approximate filter bytes — the "
+        "byte-denominated twin of --max-resident-filters (either or "
+        "both may be set; 0 = unbounded)",
+    )
+    parser.add_argument(
+        "--storage-warm-bytes",
+        type=int,
+        default=256 * 1024 * 1024,
+        metavar="B",
+        help="host-RAM blob pool budget for WARM (evicted) filters; "
+        "over budget the coldest fully-checkpointed blobs are trimmed "
+        "to COLD (checkpoint-only). Default 256MiB",
+    )
+    parser.add_argument(
+        "--hydration-max-concurrent",
+        type=int,
+        default=4,
+        metavar="N",
+        help="at most N tenant hydrations in flight; further cold-"
+        "tenant requests are shed with RESOURCE_EXHAUSTED + "
+        "retry_after_ms (default 4)",
+    )
+    parser.add_argument(
+        "--tenant-hydrations-per-min",
+        type=int,
+        default=0,
+        metavar="N",
+        help="per-tenant hydration quota (token bucket): a tenant "
+        "thrashing in and out of residency faster than this is shed "
+        "with retry_after_ms while hot tenants keep serving. 0 "
+        "disables (the default)",
+    )
+    parser.add_argument(
         "--min-replicas-max-lag-ms",
         type=int,
         default=DEFAULT_MIN_REPLICAS_MAX_LAG_MS,
@@ -2424,6 +2771,27 @@ def main(argv: Optional[list] = None) -> None:
             "cluster mode: %s (map epoch %d)",
             announce, cluster_state.epoch(),
         )
+    storage_config = None
+    if args.max_resident_filters > 0 or args.max_resident_bytes > 0:
+        from tpubloom.storage import StorageConfig
+
+        if not ckpt_dir:
+            parser.error(
+                "--max-resident-filters/--max-resident-bytes require a "
+                "checkpoint_dir (the COLD tier needs a durable sink)"
+            )
+        storage_config = StorageConfig(
+            max_resident_filters=args.max_resident_filters or None,
+            max_resident_bytes=args.max_resident_bytes or None,
+            warm_pool_bytes=args.storage_warm_bytes,
+            hydration_max_concurrent=args.hydration_max_concurrent,
+            tenant_hydrations_per_min=args.tenant_hydrations_per_min,
+        )
+        log.info(
+            "multi-tenant paging: max %s resident filter(s) / %s bytes",
+            args.max_resident_filters or "unbounded",
+            args.max_resident_bytes or "unbounded",
+        )
     coalesce = None
     if args.coalesce_max_keys > 0:
         from tpubloom.server.ingest import CoalesceConfig
@@ -2448,6 +2816,7 @@ def main(argv: Optional[list] = None) -> None:
         min_replicas_max_lag_ms=args.min_replicas_max_lag_ms,
         cluster=cluster_state,
         coalesce=coalesce,
+        storage=storage_config,
     )
     if oplog is not None:
         stats = service.replay_oplog()

@@ -1,0 +1,21 @@
+"""Multi-tenant filter paging (ISSUE 14): HBM as a cache over host RAM
+over checkpoints.
+
+Before this subsystem every filter lived in device HBM for the process
+lifetime, so tenant count was capped by device memory rather than by
+checkpoint storage. :class:`TenantStore` splits the flat server registry
+into a registry/storage pair: each tenant is **RESIDENT** (device arrays
+live, in ``service._filters``), **WARM** (serialized via
+``ckpt.snapshot_blob`` into a bounded host-RAM pool), or **COLD**
+(checkpoint/op-log only). Cold-ranked residents are evicted under a
+configurable HBM budget and lazily re-hydrated on first RPC; concurrent
+requests to an evicting/hydrating tenant block on a hydration future so
+nobody ever sees a torn filter.
+
+See :mod:`tpubloom.storage.residency` for the design notes (durability
+invariants, lock ranks, the shed-path quota story).
+"""
+
+from tpubloom.storage.residency import StorageConfig, TenantStore
+
+__all__ = ["StorageConfig", "TenantStore"]

@@ -10,7 +10,13 @@ What it checks (the ISSUE-1 acceptance list, end to end):
   ``tpubloom_keys_inserted_total``, per-RPC latency buckets, fill-ratio
   and checkpoint-lag gauges, and the per-phase histogram;
 * ``SlowlogGet`` returns entries whose request ids match the ids the
-  client generated.
+  client generated;
+* tracing (ISSUE 15): the sampling-OFF path ships NO wire fields and
+  pays no measurable overhead (insert throughput with the ring armed at
+  1.0 must stay within a generous factor of the off path — re-measured
+  once like the other perf gates), and the sampling-ON path produces a
+  span tree (``rpc.InsertBatch`` root + phase children) retrievable by
+  rid via ``TraceGet``.
 
 Run directly (``python benchmarks/obs_smoke.py`` — prints one JSON line)
 or via tier-1 (``tests/test_obs.py::test_obs_smoke`` imports
@@ -23,6 +29,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 import urllib.request
 
 
@@ -80,6 +87,70 @@ def run_smoke() -> dict:
         assert phased and {"decode", "host_prep", "kernel"} <= set(
             phased[0]["phases"]
         )
+
+        # -- tracing phase (ISSUE 15) ---------------------------------
+        from tpubloom.obs import trace as trace_mod
+
+        def measure(cl, tag):
+            # equal-length tags keep every run on ONE padded key shape —
+            # the warm-up batch eats the jit compile so neither side's
+            # window measures compilation (the re-learned PR-10 lesson)
+            batch = [b"trace-%s-%%06d" % tag % i for i in range(256)]
+            cl.insert_batch("smoke", batch)
+            t0 = time.perf_counter()
+            for _ in range(20):
+                cl.insert_batch("smoke", batch)
+            return 20 / (time.perf_counter() - t0)
+
+        # sampling OFF (this server booted without a trace knob): the
+        # client must stamp NO wire field and the ring must stay off
+        assert not trace_mod.enabled()
+        seen_reqs = []
+        orig_call = client._call_once
+
+        def spy(method, req, *a, **kw):
+            seen_reqs.append(dict(req))
+            return orig_call(method, req, *a, **kw)
+
+        client._call_once = spy
+        off_rate = measure(client, b"of0")
+        client._call_once = orig_call
+        assert seen_reqs and all("trace" not in r for r in seen_reqs), (
+            "the sampling-off path must add no wire fields"
+        )
+        off_rid = client.last_rid
+        assert client._rpc("TraceGet", {"trace_rid": off_rid}) == {
+            "ok": True, "rid": off_rid, "enabled": False, "spans": [],
+        }
+
+        # sampling ON at 1.0: spans land; overhead stays bounded.
+        # Generous bound + re-measure-once — this is an anti-regression
+        # gate on a noisy shared runner, not a microbenchmark.
+        trace_mod.configure(sample=1.0)
+        traced_client = BloomClient(f"127.0.0.1:{port}", trace_sample=1.0)
+        try:
+            on_rate = measure(traced_client, b"on0")
+            if on_rate < 0.5 * off_rate:
+                # re-measure BOTH sides honestly: the off baseline must
+                # run with the ring disarmed again — at sample 1.0 the
+                # server captures the untraced client's requests too,
+                # and a traced-vs-traced comparison would pass exactly
+                # when a real regression triggered this branch
+                trace_mod.configure(None)
+                off_rate = measure(client, b"of1")
+                trace_mod.configure(sample=1.0)
+                on_rate = measure(traced_client, b"on1")
+            assert on_rate >= 0.4 * off_rate, (
+                f"tracing overhead out of bounds: on={on_rate:.1f}/s "
+                f"vs off={off_rate:.1f}/s"
+            )
+            spans = traced_client.trace_get(traced_client.last_rid)
+            span_names = {s["name"] for s in spans}
+            assert {"rpc.InsertBatch", "client.hop",
+                    "phase.kernel"} <= span_names, span_names
+        finally:
+            trace_mod.reset_for_tests()
+
         return {
             "ok": True,
             "metrics_families": len(families),
@@ -89,6 +160,11 @@ def run_smoke() -> dict:
             "keys_inserted_total": int(
                 families["tpubloom_keys_inserted_total"][()]
             ),
+            "trace_off_wire_clean": True,
+            "trace_off_rate_per_s": round(off_rate, 1),
+            "trace_on_rate_per_s": round(on_rate, 1),
+            "trace_overhead_ratio": round(on_rate / off_rate, 3),
+            "trace_spans_sampled": len(spans),
         }
     finally:
         metrics_server.close()

@@ -36,7 +36,7 @@ class Redis
         METHODS = %w[
           Health CreateFilter DropFilter ListFilters
           InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint Wait
-          SlowlogGet SlowlogReset Promote ReplicaOf
+          SlowlogGet SlowlogReset TraceGet Promote ReplicaOf
           ClusterSlots ClusterSetSlot MigrateSlot MigrateInstall
         ].freeze
 
@@ -96,6 +96,15 @@ class Redis
         #                    it (negotiated per-connection, re-probed
         #                    after a failover re-point); "msgpack" pins
         #                    the classic per-key list
+        #   :trace         - true to force distributed-trace capture for
+        #                    every call this driver makes (ISSUE 15): each
+        #                    request carries trace => {forced: true}, so a
+        #                    --trace-sample-armed server records the full
+        #                    span tree under the call's rid regardless of
+        #                    its sample rate; #trace_get(rid) fetches the
+        #                    connected node's spans. Default off: no wire
+        #                    field is added (identical bytes to older
+        #                    drivers).
         #   :min_replicas  - default durability quorum stamped on every
         #                    mutating call (Redis min-replicas-to-write
         #                    parity, ISSUE 5): the server blocks the call
@@ -113,6 +122,8 @@ class Redis
           @sentinels = Array(opts[:sentinels])
           @epoch = nil
           @min_replicas = opts[:min_replicas]
+          @trace = !!opts[:trace]
+          @last_rid = nil
           @last_write_seq = nil
           @encoding = opts[:encoding] || "auto"
           address = opts[:address] || "127.0.0.1:50051"
@@ -228,6 +239,17 @@ class Redis
 
         def slowlog_reset
           rpc("SlowlogReset", {})["cleared"]
+        end
+
+        # Distributed-tracing lookup (ISSUE 15): the spans the connected
+        # node recorded for one rid (default: this driver's last call),
+        # plus coalescer flush spans that link it. Pair with :trace =>
+        # true so the server captures regardless of its sample rate.
+        # (trace_rid, not rid: the bare rid field is the per-call
+        # transport correlation id this driver stamps, which would
+        # clobber the lookup key.)
+        def trace_get(rid = nil)
+          rpc("TraceGet", { "trace_rid" => rid || @last_rid })["spans"]
         end
 
         # HA admin verbs (REPLICAOF NO ONE / REPLICAOF parity). Raw
@@ -374,6 +396,11 @@ class Redis
           # it. A caller-provided rid wins (the cluster driver stamps one
           # BEFORE delegating here so its redirect/re-drive hops share it)
           payload = payload.merge("rid" => payload["rid"] || SecureRandom.hex(8))
+          @last_rid = payload["rid"]
+          # trace propagation (ISSUE 15): force capture under this rid on
+          # every armed server the call touches. Off by default — the off
+          # path ships byte-identical requests to pre-trace drivers.
+          payload["trace"] = { "forced" => true } if @trace && !payload["trace"]
           attempt = 0
           shed_attempt = 0
           recreated = false

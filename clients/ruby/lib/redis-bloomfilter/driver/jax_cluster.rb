@@ -105,6 +105,44 @@ class Redis
           )
         end
 
+        # Cross-node trace assembly (ISSUE 15, the Python
+        # ClusterClient#trace twin): merge TraceGet answers from every
+        # bootstrap node for `rid`, then follow the trace ids the
+        # returned spans introduce (a coalescer flush span links the
+        # rid, but its kernel phases / barrier / replica applies live
+        # under the FLUSH trace id) — one extra fan-out round.
+        def trace(rid = nil)
+          rid ||= @last_rid
+          spans = {}
+          pending = [rid].compact
+          seen = []
+          2.times do
+            fresh = pending.uniq - seen
+            break if fresh.empty?
+            fresh.each do |tid|
+              seen << tid
+              @cluster_nodes.each do |addr|
+                stub = GRPC::ClientStub.new(addr, :this_channel_is_insecure)
+                begin
+                  raw = stub.request_response(
+                    "/#{SERVICE}/TraceGet",
+                    { "trace_rid" => tid }.to_msgpack, IDENTITY, IDENTITY
+                  )
+                  resp = MessagePack.unpack(raw)
+                  next unless resp["ok"]
+                  (resp["spans"] || []).each do |s|
+                    spans[[s["rid"], s["span"]]] = s
+                    pending << s["rid"] if s["rid"]
+                  end
+                rescue GRPC::BadStatus
+                  next
+                end
+              end
+            end
+          end
+          spans.values.sort_by { |s| s["start"] || 0.0 }
+        end
+
         private
 
         # The freshest ClusterSlots answer across the bootstrap nodes;
@@ -161,6 +199,12 @@ class Redis
           # — shares it (the server's dedup cache keys on it; a fresh
           # rid per hop would double-apply counting inserts)
           payload = payload.merge("rid" => SecureRandom.hex(8))
+          @last_rid = payload["rid"]
+          # stamp trace context HERE too (not only in the base rpc): the
+          # ASK / re-drive hops below ship `payload` through raw stubs
+          # that bypass the base driver, and every hop of one logical
+          # call must carry the same trace field as its rid (ISSUE 15)
+          payload["trace"] = { "forced" => true } if @trace && !payload["trace"]
           redirects = 0
           begin
             super

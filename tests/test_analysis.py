@@ -407,6 +407,44 @@ def test_chaos_coverage_flags_unarmed_points(tmp_path):
     assert len(by_check) == 1 and "zz.dead_point" in by_check[0].message
 
 
+def test_chaos_coverage_credits_benchmark_arming(tmp_path):
+    """ISSUE 15 satellite (the ROADMAP item 6 seam): a point armed only
+    by a benchmark harness's TPUBLOOM_FAULTS string (or faults.arm)
+    under benchmarks/ is covered, not dead surface."""
+    faults_dir = tmp_path / "tpubloom" / "faults"
+    tests_dir = tmp_path / "tests"
+    bench_dir = tmp_path / "benchmarks"
+    faults_dir.mkdir(parents=True)
+    tests_dir.mkdir()
+    bench_dir.mkdir()
+    (faults_dir / "__init__.py").write_text(
+        textwrap.dedent(
+            """
+            KNOWN_POINTS = {
+                "zz.bench_env",
+                "zz.bench_call",
+                "zz.still_dead",
+            }
+            """
+        )
+    )
+    (bench_dir / "load_harness.py").write_text(
+        textwrap.dedent(
+            """
+            import os
+            from tpubloom import faults
+
+            def run():
+                os.environ["TPUBLOOM_FAULTS"] = "zz.bench_env=nth:3"
+                faults.arm("zz.bench_call", "once")
+            """
+        )
+    )
+    findings = L.check_chaos_coverage(str(tmp_path))
+    assert [f.message.split("'")[1] for f in findings] == ["zz.still_dead"]
+    assert "benchmark" in findings[0].message
+
+
 def test_phase_registry_flags_undeclared_and_bad_dynamic(tmp_path):
     findings = _lint_source(
         tmp_path,
@@ -428,6 +466,79 @@ def test_phase_registry_flags_undeclared_and_bad_dynamic(tmp_path):
     msgs = sorted(f.message for f in findings)
     assert "'mystery_shard'" in msgs[0]
     assert "'kernel_mystery'" in msgs[1]
+
+
+def test_trace_registry_flags_undeclared_spans_and_events(tmp_path):
+    """ISSUE 15: the phase-registry pattern extended to the tracing span
+    vocabulary and the flight-recorder event vocabulary."""
+    findings = _lint_source(
+        tmp_path,
+        """
+        from tpubloom.obs import flight, trace
+
+        def f(rid, method):
+            with trace.span("good.span"):              # declared: clean
+                pass
+            with trace.span("mystery.span"):           # not declared
+                pass
+            trace.record_span(f"rpc.{method}", rid=rid,
+                              start=0.0, duration_s=0.0)  # prefix: clean
+            trace.record_span(f"zz.{method}", rid=rid,
+                              start=0.0, duration_s=0.0)  # bad prefix
+            flight.note("good_event", x=1)             # declared: clean
+            flight.note("mystery_event")               # not declared
+        """,
+        spans=frozenset({"good.span"}),
+        span_prefixes=("rpc.",),
+        events=frozenset({"good_event"}),
+    )
+    assert _checks(findings) == ["trace-registry"] * 3
+    msgs = " | ".join(sorted(f.message for f in findings))
+    assert "'mystery.span'" in msgs
+    assert "'zz.'" in msgs
+    assert "'mystery_event'" in msgs
+
+
+def test_trace_registry_reverse_check(tmp_path):
+    """Tree mode: declared spans/prefixes/events nobody emits are stale
+    vocabulary entries."""
+    pkg = tmp_path / "tpubloom" / "obs"
+    pkg.mkdir(parents=True)
+    (pkg / "names.py").write_text(
+        'SPANS = ("client.hop", "ghost.span")\n'
+        'SPAN_DYNAMIC_PREFIXES = (("rpc.", "roots"), ("zz.", "ghost"),)\n'
+        'EVENTS = ("shed", "ghost_event")\n'
+    )
+    src = tmp_path / "emit.py"
+    src.write_text(
+        textwrap.dedent(
+            """
+            from tpubloom.obs import flight, trace
+
+            def f(rid, method):
+                with trace.span("client.hop"):
+                    pass
+                trace.record_span(f"rpc.{method}", rid=rid,
+                                  start=0.0, duration_s=0.0)
+                flight.note("shed")
+            """
+        )
+    )
+    config = L.LintConfig(
+        **{
+            **{k: v for k, v in CONFIG_KW.items() if k != "tree_checks"},
+            "tree_checks": True,
+            "repo_root": str(tmp_path),
+        }
+    )
+    findings = L.lint_paths([str(src)], config)
+    tr = sorted(
+        f.message for f in findings if f.check == "trace-registry"
+    )
+    assert len(tr) == 3
+    assert "'ghost.span'" in tr[2] or "'ghost.span'" in " ".join(tr)
+    assert any("'zz.'" in m for m in tr)
+    assert any("'ghost_event'" in m for m in tr)
 
 
 def test_phase_registry_reverse_check(tmp_path):

@@ -70,6 +70,15 @@ GOLDEN = {
     "DropFilter": ("DropFilter", "81a46e616d65aa676f6c64656e2d636e74"),
     "SlowlogGet": ("SlowlogGet", "81a16e0a"),
     "SlowlogReset": ("SlowlogReset", "80"),
+    # distributed tracing (ISSUE 15): a trace lookup is a read-only
+    # ring query — safe to replay raw on any server; with tracing
+    # disabled it answers enabled:false + an empty span list, which is
+    # exactly the shape the Ruby driver's trace_get parses. The lookup
+    # key is trace_rid (the bare rid field is the transport correlation
+    # id clients stamp per call, which would clobber it).
+    "TraceGet": (
+        "TraceGet", "81a974726163655f726964aa676f6c64656e2d726964"
+    ),
     # HA verbs (ISSUE 4): a bare Promote and REPLICAOF NO ONE are both
     # idempotent no-ops on a primary — safe to replay raw
     "Promote": ("Promote", "80"),
@@ -150,6 +159,7 @@ GOLDEN_DICTS = {
     "DropFilter": {"name": "golden-cnt"},
     "SlowlogGet": {"n": 10},
     "SlowlogReset": {},
+    "TraceGet": {"trace_rid": "golden-rid"},
     "Promote": {},
     "ReplicaOf": {"primary": "NO ONE"},
     "Wait": {"numreplicas": 0, "timeout_ms": 50},
@@ -317,6 +327,13 @@ def test_golden_replay_against_live_server(raw_server):
     assert e["method"] in protocol.METHODS and e["rid"]
     r = _call(ch, *GOLDEN["SlowlogReset"])
     assert r["ok"] and r["cleared"] > 0
+
+    # TraceGet (ISSUE 15): with tracing disabled (this server's
+    # default) the lookup still answers the structured shape —
+    # enabled:false + an empty span list, never an error
+    r = _call(ch, *GOLDEN["TraceGet"])
+    assert r["ok"] and r["rid"] == "golden-rid"
+    assert r["enabled"] is False and r["spans"] == []
 
     # error shape the Ruby driver's rpc_once parses
     bad = msgpack.packb({"name": "missing-filter", "keys": [b"x"]},

@@ -1,0 +1,509 @@
+"""ISSUE 15: distributed request tracing + flight recorder.
+
+Covers the tentpole end to end:
+
+* the **span ring**: record/lookup by trace id, flush-span LINK
+  reverse-indexing, bounded eviction, deterministic per-rid sampling;
+* the **request tree**: client hop → ``rpc.<Method>`` root → phase
+  children + ``barrier.wait``, parented across the wire via the
+  ``trace`` request field; slowlog-worthy requests captured even
+  unsampled; the fully-off path records nothing and ships no wire
+  field;
+* the **coalescer**: one ``ingest.flush`` span per flush, LINKING every
+  parked request's root span, kernel phases + the barrier as flush
+  children — N-to-1 batching stays explainable;
+* the **acceptance e2e**: a real subprocess primary (cluster mode +
+  coalescer + ``--trace-sample 1.0``) with a real replica — one quorum
+  write's assembled tree connects client hop → park/flush → kernel
+  phases → commit barrier → replica apply, as ONE component; the
+  primary's SIGTERM then produces a readable flight-recorder dump;
+* the **flight recorder**: bounded ring, JSON dumps, and the Health
+  SERVING→DEGRADED flip triggering a dump.
+
+The module runs armed under the lock tracker + lock-order manifest like
+the other chaos modules — the new ``obs.trace`` ring lock must stay a
+leaf (every record/lookup site holds no other lock).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpubloom import checkpoint as ckpt
+from tpubloom import faults
+from tpubloom.obs import flight, trace
+from tpubloom.server import protocol
+from tpubloom.server.client import BloomClient
+from tpubloom.server.ingest import CoalesceConfig
+from tpubloom.server.service import BloomService, build_server
+
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    trace.reset_for_tests()
+    flight.reset_for_tests()
+    faults.reset()
+    yield
+    trace.reset_for_tests()
+    flight.reset_for_tests()
+    faults.reset()
+
+
+class _Server:
+    def __init__(self, service):
+        self.service = service
+        self.server, self.port = build_server(service, "127.0.0.1:0")
+        self.server.start()
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def client(self, **kw) -> BloomClient:
+        return BloomClient(self.addr, **kw)
+
+    def stop(self):
+        self.server.stop(grace=None)
+
+
+def _names(spans):
+    return sorted(s["name"] for s in spans)
+
+
+# -- the ring ----------------------------------------------------------------
+
+
+def test_ring_record_lookup_links_and_eviction():
+    trace.configure(sample=1.0, capacity=10)
+    trace.record_span("rpc.X", rid="r1", start=1.0, duration_s=0.5)
+    root = trace.record_span("rpc.Y", rid="r2", start=2.0, duration_s=0.1)
+    trace.record_span(
+        "phase.kernel", rid="r2", parent=root, start=2.0, duration_s=0.05
+    )
+    # a flush-style span in its OWN trace that links r1: r1's lookup
+    # must pull the whole linking trace along
+    trace.record_span(
+        "ingest.flush", rid="fl-1", start=3.0, duration_s=0.2,
+        links=[{"rid": "r1", "span": "aaaa"}],
+    )
+    trace.record_span(
+        "barrier.wait", rid="fl-1", start=3.1, duration_s=0.1
+    )
+    got = trace.get_trace("r1")
+    assert _names(got) == ["barrier.wait", "ingest.flush", "rpc.X"]
+    assert _names(trace.get_trace("r2")) == ["phase.kernel", "rpc.Y"]
+    # eviction: oldest traces fall out once the span budget is hit, and
+    # their link index entries go with them
+    for i in range(30):
+        trace.record_span(f"rpc.Z{i}", rid=f"bulk-{i}", start=float(i),
+                          duration_s=0.0)
+    assert trace.buffer_stats()["spans"] <= 10
+    assert trace.get_trace("r1") == []
+    # a SINGLE trace id over the whole budget is still bounded (a
+    # caller reusing one rid across many forced calls must not leak)
+    trace.configure(sample=1.0, capacity=10)
+    for i in range(40):
+        trace.record_span(f"rpc.S{i}", rid="one-rid", start=float(i),
+                          duration_s=0.0)
+    assert trace.buffer_stats()["spans"] <= 10
+    kept = trace.get_trace("one-rid")
+    assert len(kept) <= 10 and kept[-1]["name"] == "rpc.S39"
+
+
+def test_deterministic_sampling_and_off_switch():
+    trace.configure(sample=1.0)
+    assert trace.hit("anything")
+    trace.configure(sample=0.0)
+    assert not trace.hit("anything")
+    # the decision is a pure function of (rid, rate) — every node that
+    # sees the same rid agrees with no coordination
+    trace.configure(sample=0.5)
+    decisions = {rid: trace.hit(rid) for rid in ("a", "b", "c", "d", "e")}
+    for rid, d in decisions.items():
+        assert trace.hit(rid) == d
+        assert trace.hit(rid, 0.5) == d
+    # fully off: nothing records, lookups answer empty
+    trace.configure(None)
+    assert not trace.enabled()
+    trace.record_span("rpc.X", rid="off", start=0.0, duration_s=0.0)
+    assert trace.get_trace("off") == []
+
+
+# -- request trees (in-process server) ----------------------------------------
+
+
+def test_request_tree_client_hop_to_phases(tmp_path):
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path)),
+        trace_sample=1.0,
+    ))
+    try:
+        c = srv.client(trace_sample=1.0)
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        c.insert_batch("t", [b"k%d" % i for i in range(16)])
+        rid = c.last_rid
+        spans = c.trace_get(rid)
+        names = _names(spans)
+        assert "rpc.InsertBatch" in names and "client.hop" in names
+        assert "phase.decode" in names and "phase.kernel" in names
+        assert "barrier.wait" in names  # 0-quorum: present, ~0s
+        root = next(s for s in spans if s["name"] == "rpc.InsertBatch")
+        hop = next(s for s in spans if s["name"] == "client.hop")
+        # the wire trace field parented the server root under the hop
+        assert root["parent"] == hop["span"]
+        assert root["attrs"]["filter"] == "t"
+        assert root["attrs"]["code"] == "OK"
+        assert root["attrs"]["batch"] == 16
+        # every phase child hangs off the root — one connected tree
+        tree = trace.assemble(spans)
+        assert len(tree["components"]) == 1
+        assert [hop["span"]] == tree["roots"]
+    finally:
+        srv.stop()
+
+
+def test_slowlog_worthy_requests_capture_unsampled(tmp_path):
+    # ring armed at rate 0.0: nothing samples, but the slowlog keeps
+    # everything (threshold 0, empty heap) — so the request still lands
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path)),
+        trace_sample=0.0,
+    ))
+    try:
+        c = srv.client()  # client tracing off: no wire field
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        c.insert_batch("t", [b"a", b"b"])
+        spans = c.trace_get(c.last_rid)
+        assert "rpc.InsertBatch" in _names(spans)
+        root = next(s for s in spans if s["name"] == "rpc.InsertBatch")
+        assert root["parent"] is None  # no client hop: nothing propagated
+    finally:
+        srv.stop()
+
+
+def test_tracing_off_is_wire_silent_and_records_nothing(tmp_path):
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path)),
+    ))
+    try:
+        c = srv.client()
+        seen = []
+        orig = c._call_once
+
+        def spy(method, req, *a, **kw):
+            seen.append(dict(req))
+            return orig(method, req, *a, **kw)
+
+        c._call_once = spy
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        c.insert_batch("t", [b"a", b"b"])
+        assert all("trace" not in r for r in seen), (
+            "tracing off must add no wire fields"
+        )
+        resp = c._rpc("TraceGet", {"trace_rid": c.last_rid})
+        assert resp["enabled"] is False and resp["spans"] == []
+    finally:
+        srv.stop()
+
+
+def test_coalesced_flush_span_links_every_parked_request(tmp_path):
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path)),
+        coalesce=CoalesceConfig(max_keys=4096, max_wait_us=20_000),
+        trace_sample=1.0,
+    ))
+    try:
+        admin = srv.client()
+        admin.wait_ready()
+        admin.create_filter("t", capacity=50_000, error_rate=0.01)
+        rids = []
+
+        def work(i):
+            cc = srv.client(trace_sample=1.0)
+            cc.insert_batch(
+                "t", [b"k-%d-%d" % (i, j) for j in range(64)]
+            )
+            rids.append(cc.last_rid)
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            spans = admin.trace_get(rids[0])
+            if any(s["name"] == "ingest.flush" for s in spans):
+                break
+            time.sleep(0.05)
+        names = _names(spans)
+        assert "ingest.flush" in names, names
+        assert "ingest.park" in names
+        assert "phase.kernel" in names  # the flush's kernel phase child
+        flush = next(s for s in spans if s["name"] == "ingest.flush")
+        assert flush["rid"] != rids[0]  # its own trace id
+        linked = {link["rid"] for link in flush["links"]}
+        assert rids[0] in linked
+        assert flush["attrs"]["requests"] == len(flush["links"])
+        # the lookup stitched request + flush traces into ONE component
+        tree = trace.assemble(spans)
+        assert len(tree["components"]) == 1
+        # a flush-mate's lookup finds the SAME flush span
+        if len(linked) > 1:
+            other = next(r for r in linked if r != rids[0])
+            other_spans = admin.trace_get(other)
+            assert any(
+                s["name"] == "ingest.flush" and s["span"] == flush["span"]
+                for s in other_spans
+            )
+    finally:
+        srv.stop()
+
+
+def test_http_trace_and_flight_views(tmp_path):
+    import urllib.request
+
+    from tpubloom.obs.httpd import start_metrics_server
+
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path)),
+        trace_sample=1.0,
+    ))
+    metrics = start_metrics_server(srv.service, port=0, host="127.0.0.1")
+    try:
+        c = srv.client(trace_sample=1.0)
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        c.insert_batch("t", [b"a", b"b"])
+        rid = c.last_rid
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.port}/trace?rid={rid}", timeout=10
+        ) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["rid"] == rid and body["enabled"] is True
+        assert "rpc.InsertBatch" in {s["name"] for s in body["spans"]}
+        flight.note("shed", method="probe")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.port}/flight", timeout=10
+        ) as resp:
+            body = json.loads(resp.read().decode())
+        assert any(e["kind"] == "shed" for e in body["events"])
+    finally:
+        metrics.close()
+        srv.stop()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_ring_bounded_snapshot_and_dump(tmp_path):
+    flight.configure(dump_dir=str(tmp_path), capacity=8)
+    for i in range(20):
+        flight.note("shed", i=i)
+    events = flight.snapshot()
+    assert len(events) == 8  # bounded, newest kept
+    assert events[-1]["attrs"]["i"] == 19
+    path = flight.dump("ondemand", extra={"why": "test"})
+    assert path is not None and os.path.isfile(path)
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "ondemand"
+    assert payload["extra"] == {"why": "test"}
+    assert len(payload["events"]) == 8
+    # no dir configured -> dump declines instead of raising
+    flight.reset_for_tests()
+    env_dir = os.environ.pop(flight.DUMP_DIR_ENV, None)
+    try:
+        assert flight.dump("nowhere") is None
+    finally:
+        if env_dir is not None:
+            os.environ[flight.DUMP_DIR_ENV] = env_dir
+
+
+def test_health_degraded_flip_dumps_flight_recorder(tmp_path):
+    flight.configure(dump_dir=str(tmp_path / "dumps"))
+    srv = _Server(BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path / "ckpt")),
+    ))
+    try:
+        c = srv.client()
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        # force a checkpoint-write error -> Health DEGRADED
+        faults.arm("ckpt.write", "always")
+        c.insert_batch("t", [b"x"])
+        try:
+            c.checkpoint("t", wait=True)
+        except protocol.BloomServiceError:
+            pass
+        h = c.health()
+        assert h["status"] == "DEGRADED", h
+        dumps = list((tmp_path / "dumps").glob("flight-*-degraded-*.json"))
+        assert len(dumps) == 1, "the SERVING->DEGRADED flip must dump once"
+        payload = json.loads(dumps[0].read_text())
+        flip = [e for e in payload["events"] if e["kind"] == "health"]
+        assert flip and flip[-1]["attrs"]["status"] == "DEGRADED"
+        assert flip[-1]["attrs"]["reasons"]
+        # a second DEGRADED Health answer is NOT a flip: no second dump
+        c.health()
+        assert len(
+            list((tmp_path / "dumps").glob("flight-*-degraded-*.json"))
+        ) == 1
+    finally:
+        faults.reset()
+        srv.stop()
+
+
+# -- the acceptance e2e: subprocess primary + replica + cluster hop ----------
+
+
+_SERVER_CHILD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(tmp_path, script_name, args, flight_dir):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        flight.DUMP_DIR_ENV: str(flight_dir),
+    }
+    script = tmp_path / script_name
+    script.write_text(_SERVER_CHILD)
+    return subprocess.Popen(
+        [sys.executable, str(script)] + [str(a) for a in args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def test_e2e_quorum_write_trace_and_sigterm_dump(tmp_path):
+    """THE acceptance run: real subprocess primary (cluster mode +
+    coalescer + oplog + --trace-sample 1.0) and replica; one quorum
+    write routed through the ClusterClient assembles into a SINGLE
+    connected span tree covering client hop → coalescer park/flush →
+    kernel phases → commit barrier → replica apply; SIGTERMing the
+    primary then writes a readable flight-recorder dump."""
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    pport, rport = _free_port(), _free_port()
+    primary = _spawn(
+        tmp_path, "primary.py",
+        [pport, tmp_path / "p-ckpt",
+         "--repl-log-dir", tmp_path / "p-oplog",
+         "--cluster", "--coalesce-max-keys", 4096,
+         "--coalesce-max-wait-us", 2000,
+         "--min-replicas-to-write", 1,
+         "--trace-sample", "1.0"],
+        flight_dir,
+    )
+    replica = _spawn(
+        tmp_path, "replica.py",
+        [rport, tmp_path / "r-ckpt",
+         "--replica-of", f"127.0.0.1:{pport}",
+         "--trace-sample", "1.0"],
+        flight_dir,
+    )
+    from tpubloom.cluster.client import ClusterClient
+
+    try:
+        paddr = f"127.0.0.1:{pport}"
+        raddr = f"127.0.0.1:{rport}"
+        admin = BloomClient(paddr, timeout=30.0)
+        admin.wait_ready(timeout=120)
+        admin.cluster_set_slot(assign=[[0, 16383, paddr]], epoch=1)
+        # the quorum needs the replica CONNECTED before the write
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            h = admin.health()
+            if len((h.get("replication") or {}).get("replicas") or ()) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("replica never connected")
+
+        trace.configure(sample=1.0)  # arm the TEST process's client ring
+        cc = ClusterClient(
+            startup_nodes=[paddr], replicas=[raddr],
+            trace_sample=1.0, timeout=30.0,
+        )
+        cc.create_filter("e2e", capacity=50_000, error_rate=0.01)
+        cc.insert_batch(
+            "e2e", [b"q-%d" % i for i in range(64)], min_replicas=1
+        )
+        rid = cc.last_rid
+
+        # assemble: local client spans + primary (request + flush
+        # traces) + replica (apply spans under the flush trace id)
+        assembled = None
+        deadline = time.monotonic() + 20
+        want = {"client.hop", "rpc.InsertBatch", "ingest.park",
+                "ingest.flush", "phase.kernel", "barrier.wait",
+                "repl.apply"}
+        while time.monotonic() < deadline:
+            assembled = cc.trace(rid)
+            if want <= {s["name"] for s in assembled["spans"]}:
+                break
+            time.sleep(0.2)
+        names = {s["name"] for s in assembled["spans"]}
+        assert want <= names, f"missing {want - names}: {sorted(names)}"
+        # ONE connected component: the rid's request tree, the flush
+        # trace it links, and the replica's apply of the merged record
+        assert len(assembled["components"]) == 1, assembled["components"]
+        flush = next(
+            s for s in assembled["spans"] if s["name"] == "ingest.flush"
+        )
+        assert rid in {link["rid"] for link in flush["links"]}
+        apply_span = next(
+            s for s in assembled["spans"] if s["name"] == "repl.apply"
+        )
+        # the apply is stamped with the flush's trace id (the merged
+        # record's origin rid) and carries the op-log seq
+        assert apply_span["rid"] == flush["rid"]
+        assert apply_span["attrs"]["seq"] >= 1
+        assert apply_span["attrs"]["filter"] == "e2e"
+        barrier = next(
+            s for s in assembled["spans"] if s["name"] == "barrier.wait"
+        )
+        assert barrier["parent"] == flush["span"]
+        cc.close()
+
+        # SIGTERM the primary: drain + flight dump land in the env dir
+        primary.send_signal(signal.SIGTERM)
+        assert primary.wait(timeout=60) == 0
+        dumps = sorted(flight_dir.glob("flight-*-sigterm-*.json"))
+        assert dumps, "SIGTERM must produce a flight-recorder dump"
+        payload = json.loads(dumps[0].read_text())
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "drain" in kinds
+        assert payload["reason"] == "sigterm" and payload["pid"]
+    finally:
+        for proc in (primary, replica):
+            if proc.poll() is None:
+                proc.kill()
+            out = proc.stdout.read() if proc.stdout else ""
+            if proc.returncode not in (0, -9):
+                print(out[-4000:])

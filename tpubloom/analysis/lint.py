@@ -85,11 +85,14 @@ Checks
 
 ``chaos-coverage``
     (tree mode) Every ``faults.KNOWN_POINTS`` entry is ARMED by literal
-    in at least one test — via ``faults.arm("point", ...)`` or a
-    ``TPUBLOOM_FAULTS``-syntax string (``"point=policy"``) in
-    ``tests/``. A declared-but-never-armed point is dead chaos surface:
-    the failure path it guards has never actually been driven.
-    Suppress (with a reason) on the point's ``KNOWN_POINTS`` line.
+    in at least one test or benchmark harness — via ``faults.arm(
+    "point", ...)`` or a ``TPUBLOOM_FAULTS``-syntax string
+    (``"point=policy"``) in ``tests/`` or ``benchmarks/`` (ISSUE 15
+    closed the ROADMAP item 6 seam: arming that lives in a load
+    harness rather than pytest counts). A declared-but-never-armed
+    point is dead chaos surface: the failure path it guards has never
+    actually been driven. Suppress (with a reason) on the point's
+    ``KNOWN_POINTS`` line.
 
 ``phase-registry``
     Every literal phase name passed to ``obs.phase(...)`` /
@@ -100,6 +103,18 @@ Checks
     every declared phase/prefix is emitted somewhere — the PR-6
     counter-registry pattern extended to the phase vocabulary so
     dashboards and the slowlog keep lining up.
+
+``trace-registry``
+    (ISSUE 15) The same closure for the distributed-tracing span
+    vocabulary and the flight-recorder event vocabulary: every literal
+    name at a ``trace.span(...)`` / ``trace.record_span(...)`` site is
+    declared in :data:`tpubloom.obs.names.SPANS` (f-string heads must
+    match :data:`tpubloom.obs.names.SPAN_DYNAMIC_PREFIXES` —
+    ``rpc.<Method>``, ``phase.<name>``), every ``flight.note(...)``
+    kind is declared in :data:`tpubloom.obs.names.EVENTS`, and (tree
+    mode) every declared span/prefix/event has an emit site — a
+    TraceGet tree and a flight dump must never contain a name the
+    catalog cannot explain, and the catalog cannot rot.
 
 Suppressions
 ============
@@ -138,6 +153,7 @@ CHECKS = (
     "barrier-outside-lock",
     "chaos-coverage",
     "phase-registry",
+    "trace-registry",
     "suppression-reason",
     "unknown-suppression",
     "unused-suppression",
@@ -218,6 +234,11 @@ class LintConfig:
     #: declared phase vocabulary (None = parse ``tpubloom/obs/names.py``)
     phases: Optional[frozenset] = None
     phase_prefixes: Optional[tuple] = None
+    #: declared span/event vocabularies (ISSUE 15 ``trace-registry``;
+    #: None = parse ``tpubloom/obs/names.py``)
+    spans: Optional[frozenset] = None
+    span_prefixes: Optional[tuple] = None
+    events: Optional[frozenset] = None
     #: run the cross-file tree checks (protocol coverage + reverse
     #: registry checks) against ``repo_root``
     tree_checks: bool = True
@@ -386,6 +407,14 @@ class _FileVisitor(ast.NodeVisitor):
         self.phase_uses: list = []
         #: (literal-prefix, line) dynamic (f-string) phase emissions
         self.phase_dynamic_uses: list = []
+        #: (name, line) literal span emissions (trace.span /
+        #: trace.record_span — incl. trace.py's own bare record_span
+        #: calls) — ISSUE 15 ``trace-registry``
+        self.span_uses: list = []
+        #: (literal-prefix, line) dynamic (f-string) span emissions
+        self.span_dynamic_uses: list = []
+        #: (kind, line) literal flight-recorder events (flight.note)
+        self.event_uses: list = []
         #: every string constant in the file (reverse fault check)
         self.str_constants: set = set()
 
@@ -436,6 +465,7 @@ class _FileVisitor(ast.NodeVisitor):
         self._collect_fault_use(node)
         self._collect_metric_use(node)
         self._collect_phase_use(node)
+        self._collect_trace_use(node)
         self.generic_visit(node)
 
     # -- checks -------------------------------------------------------------
@@ -534,6 +564,43 @@ class _FileVisitor(ast.NodeVisitor):
             if arg.values and isinstance(arg.values[0], ast.Constant):
                 head = str(arg.values[0].value)
             self.phase_dynamic_uses.append((head, node.lineno))
+
+    def _collect_trace_use(self, node: ast.Call) -> None:
+        """Literal/dynamic span names at ``trace.span(...)`` /
+        ``trace.record_span(...)`` sites and event kinds at
+        ``flight.note(...)`` sites (ISSUE 15 ``trace-registry``). The
+        trace module's own internal minting calls ``record_span`` as a
+        bare name — accepted too (the name is distinctive), so the
+        ``rpc.``/``phase.`` prefixes have visible emit sites."""
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv = _dotted(func.value).lower()
+        elif isinstance(func, ast.Name):
+            attr = func.id
+            recv = None
+        else:
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if attr in ("span", "record_span"):
+            if recv is not None and "trace" not in recv:
+                return
+            if recv is None and attr != "record_span":
+                return  # a bare span() is too generic to claim
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self.span_uses.append((arg.value, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                head = ""
+                if arg.values and isinstance(arg.values[0], ast.Constant):
+                    head = str(arg.values[0].value)
+                self.span_dynamic_uses.append((head, node.lineno))
+        elif attr == "note":
+            if recv is None or "flight" not in recv:
+                return
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self.event_uses.append((arg.value, node.lineno))
 
 
 # -- donation safety (ISSUE 13) ----------------------------------------------
@@ -734,6 +801,45 @@ def _apply_registry_checks(
             )
             f._lines = (line,)  # type: ignore[attr-defined]
             visitor.findings.append(f)
+    if config.spans is not None:
+        sprefixes = tuple(config.span_prefixes or ())
+        for name, line in visitor.span_uses:
+            if name in config.spans or any(
+                name.startswith(p) for p in sprefixes
+            ):
+                continue
+            f = Finding(
+                "trace-registry", visitor.path, line,
+                f"span {name!r} is not declared in tpubloom.obs.names."
+                f"SPANS — the trace vocabulary is central so TraceGet "
+                f"trees, /trace and dashboards line up",
+            )
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
+        for head, line in visitor.span_dynamic_uses:
+            if head and any(head.startswith(p) for p in sprefixes):
+                continue
+            f = Finding(
+                "trace-registry", visitor.path, line,
+                f"dynamic span name with literal head {head!r} matches "
+                f"no declared SPAN_DYNAMIC_PREFIXES entry in "
+                f"tpubloom.obs.names — minted span series need a "
+                f"declared shape",
+            )
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
+    if config.events is not None:
+        for kind, line in visitor.event_uses:
+            if kind in config.events:
+                continue
+            f = Finding(
+                "trace-registry", visitor.path, line,
+                f"flight-recorder event {kind!r} is not declared in "
+                f"tpubloom.obs.names.EVENTS — a typo'd kind silently "
+                f"mints a series no post-mortem tooling knows",
+            )
+            f._lines = (line,)  # type: ignore[attr-defined]
+            visitor.findings.append(f)
 
 
 def lint_file(path: str, config: LintConfig) -> tuple:
@@ -841,14 +947,9 @@ def load_fault_points(repo_root: str) -> frozenset:
     )
 
 
-def load_phase_names(repo_root: str) -> tuple:
-    """(phases, dynamic prefixes) from obs/names.py (ISSUE 13); empty
-    when the catalog is absent (partial fixture trees)."""
-    path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
-    if not os.path.isfile(path):
-        return frozenset(), ()
-    decls = _parse_string_collection(path, ("PHASES",))
-    phases = frozenset(decls.get("PHASES", ()))
+def _parse_prefix_heads(path: str, target_name: str) -> tuple:
+    """The literal prefix heads of a ``((prefix, why), ...)``-shaped
+    module-level assignment (the *_DYNAMIC_PREFIXES declarations)."""
     prefixes = []
     with open(path, "r", encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
@@ -856,10 +957,9 @@ def load_phase_names(repo_root: str) -> tuple:
         if not isinstance(node, ast.Assign):
             continue
         for t in node.targets:
-            if isinstance(t, ast.Name) and t.id == "PHASE_DYNAMIC_PREFIXES":
+            if isinstance(t, ast.Name) and t.id == target_name:
                 coll = _collection_node(node.value)
                 for e in (coll.elts if coll is not None else ()):
-                    # entries are (prefix, why) pairs like DYNAMIC_PREFIXES
                     inner = _collection_node(e)
                     if (
                         inner is not None
@@ -868,7 +968,32 @@ def load_phase_names(repo_root: str) -> tuple:
                         and isinstance(inner.elts[0].value, str)
                     ):
                         prefixes.append(inner.elts[0].value)
-    return phases, tuple(prefixes)
+    return tuple(prefixes)
+
+
+def load_phase_names(repo_root: str) -> tuple:
+    """(phases, dynamic prefixes) from obs/names.py (ISSUE 13); empty
+    when the catalog is absent (partial fixture trees)."""
+    path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    if not os.path.isfile(path):
+        return frozenset(), ()
+    decls = _parse_string_collection(path, ("PHASES",))
+    phases = frozenset(decls.get("PHASES", ()))
+    return phases, _parse_prefix_heads(path, "PHASE_DYNAMIC_PREFIXES")
+
+
+def load_trace_names(repo_root: str) -> tuple:
+    """(spans, span prefixes, events) from obs/names.py (ISSUE 15);
+    empty when the catalog is absent (partial fixture trees)."""
+    path = os.path.join(repo_root, "tpubloom", "obs", "names.py")
+    if not os.path.isfile(path):
+        return frozenset(), (), frozenset()
+    decls = _parse_string_collection(path, ("SPANS", "EVENTS"))
+    return (
+        frozenset(decls.get("SPANS", ())),
+        _parse_prefix_heads(path, "SPAN_DYNAMIC_PREFIXES"),
+        frozenset(decls.get("EVENTS", ())),
+    )
 
 
 def load_metric_names(repo_root: str) -> tuple:
@@ -1104,18 +1229,25 @@ def check_replay_safety(repo_root: str) -> list:
     return findings
 
 
-#: Where the chaos-coverage check looks for arming sites.
+#: Where the chaos-coverage check looks for arming sites. Benchmarks
+#: count too (ISSUE 15 satellite — the ROADMAP item 6 seam): a fault
+#: point driven only by a benchmark harness's ``TPUBLOOM_FAULTS``
+#: string (or a direct ``faults.arm``) is covered, not dead surface.
 TESTS_DIR = "tests"
+BENCHMARKS_DIR = "benchmarks"
 
 _FAULT_ENV_RE = re.compile(r"([a-z_]+(?:\.[a-z_]+)+)\s*=")
 
 
-def _collect_armed_points(tests_dir: str, known: frozenset) -> set:
-    """Fault points armed by literal anywhere under ``tests/``: a
-    ``faults.arm("point", ...)`` call, or a ``TPUBLOOM_FAULTS``-syntax
-    string constant (``"point=policy[,point=policy...]"``)."""
+def _collect_armed_points(dirs, known: frozenset) -> set:
+    """Fault points armed by literal anywhere under the given
+    directories: a ``faults.arm("point", ...)`` call, or a
+    ``TPUBLOOM_FAULTS``-syntax string constant
+    (``"point=policy[,point=policy...]"``)."""
     armed: set = set()
-    for path in iter_py_files([tests_dir]):
+    if isinstance(dirs, str):
+        dirs = [dirs]
+    for path in iter_py_files(list(dirs)):
         try:
             with open(path, "r", encoding="utf-8") as f:
                 tree = ast.parse(f.read(), filename=path)
@@ -1158,7 +1290,13 @@ def check_chaos_coverage(repo_root: str) -> list:
     if not decls:
         return []
     known = frozenset(p for p, _ in decls)
-    armed = _collect_armed_points(os.path.join(repo_root, TESTS_DIR), known)
+    armed = _collect_armed_points(
+        [
+            os.path.join(repo_root, TESTS_DIR),
+            os.path.join(repo_root, BENCHMARKS_DIR),
+        ],
+        known,
+    )
     findings = []
     for point, line in decls:
         if point in armed:
@@ -1166,9 +1304,10 @@ def check_chaos_coverage(repo_root: str) -> list:
         f = Finding(
             "chaos-coverage", faults_path, line,
             f"fault point {point!r} is declared but never armed in any "
-            f"test (no faults.arm literal, no TPUBLOOM_FAULTS string) — "
-            f"dead chaos surface: add an armed test or suppress here "
-            f"with the reason the path is covered another way",
+            f"test or benchmark harness (no faults.arm literal, no "
+            f"TPUBLOOM_FAULTS string) — dead chaos surface: add an "
+            f"armed test or suppress here with the reason the path is "
+            f"covered another way",
         )
         f._lines = (line,)  # type: ignore[attr-defined]
         findings.append(f)
@@ -1217,11 +1356,20 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
             findings.extend(dup_findings)
     if config.phases is None:
         config.phases, config.phase_prefixes = load_phase_names(repo_root)
+    if config.spans is None or config.events is None:
+        spans, span_prefixes, events = load_trace_names(repo_root)
+        if config.spans is None:
+            config.spans, config.span_prefixes = spans, span_prefixes
+        if config.events is None:
+            config.events = events
 
     fault_literal_seen: set = set()
     metric_literal_seen: set = set()
     phase_literal_seen: set = set()
     phase_prefix_seen: set = set()
+    span_literal_seen: set = set()
+    span_prefix_seen: set = set()
+    event_literal_seen: set = set()
     fault_registry_path = os.path.join(
         repo_root, "tpubloom", "faults", "__init__.py"
     )
@@ -1244,6 +1392,9 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
             metric_literal_seen |= {n for n, _, _ in visitor.metric_uses}
         phase_literal_seen |= {n for n, _ in visitor.phase_uses}
         phase_prefix_seen |= {h for h, _ in visitor.phase_dynamic_uses if h}
+        span_literal_seen |= {n for n, _ in visitor.span_uses}
+        span_prefix_seen |= {h for h, _ in visitor.span_dynamic_uses if h}
+        event_literal_seen |= {k for k, _ in visitor.event_uses}
 
     if config.tree_checks:
         tree_findings: list = []
@@ -1292,6 +1443,40 @@ def lint_paths(paths: Iterable[str], config: Optional[LintConfig] = None) -> lis
                         f"vocabulary entry",
                     )
                 )
+        for name in sorted((config.spans or frozenset()) - span_literal_seen):
+            tree_findings.append(
+                Finding(
+                    "trace-registry", names_path, 0,
+                    f"declared span {name!r} is never emitted in the "
+                    f"linted tree — stale vocabulary entry",
+                )
+            )
+        for prefix in config.span_prefixes or ():
+            if not any(
+                h.startswith(prefix) or prefix.startswith(h)
+                for h in span_prefix_seen
+            ) and not any(
+                n.startswith(prefix) for n in span_literal_seen
+            ):
+                tree_findings.append(
+                    Finding(
+                        "trace-registry", names_path, 0,
+                        f"declared dynamic span prefix {prefix!r} has no "
+                        f"emit site in the linted tree — stale "
+                        f"vocabulary entry",
+                    )
+                )
+        for kind in sorted(
+            (config.events or frozenset()) - event_literal_seen
+        ):
+            tree_findings.append(
+                Finding(
+                    "trace-registry", names_path, 0,
+                    f"declared flight-recorder event {kind!r} is never "
+                    f"emitted in the linted tree — stale vocabulary "
+                    f"entry",
+                )
+            )
         # tree findings honor inline suppressions at their anchor line
         # (the declaration/def they point at), same grammar as per-file
         for f in tree_findings:

@@ -30,6 +30,7 @@ import grpc
 
 from tpubloom.cluster import slots as slots_mod
 from tpubloom.obs import counters as obs_counters
+from tpubloom.obs import trace as trace_mod
 from tpubloom.server import protocol
 from tpubloom.server.client import BloomClient
 from tpubloom.utils import locks
@@ -276,34 +277,70 @@ class ClusterClient:
         last: Exception = protocol.BloomServiceError(
             "MIGRATE_FORWARD_FAILED", "re-drive never attempted"
         )
-        for i in range(30):
-            time.sleep(min(1.0, 0.05 * (i + 1)))
-            try:
-                return client._call_once(
-                    method, self._hop_req(client, req, keys, {"rid": rid})
-                )
-            except protocol.BloomServiceError as e:
-                last = e
-                if e.code == "MIGRATE_FORWARD_FAILED":
-                    if e.details.get("src_seq") is not None:
-                        src_seq = e.details["src_seq"]
-                    continue  # install still in flight — keep re-driving
-                if e.code in ("MOVED", "ASK"):
-                    # the handoff finalized mid-re-drive: land the SAME
-                    # rid + src_seq on the new owner (its gate/dedup
-                    # absorbs a record that already made it across)
-                    target = self._client_for(e.details["addr"])
-                    follow = self._hop_req(
-                        target, req, keys, {"rid": rid, "asking": True}
+        w0, t0 = time.time(), time.perf_counter()
+        # same deterministic decision the original hop made for this
+        # rid; the re-drive bypasses _rpc (it must not re-mint a rid),
+        # so it carries the forced trace field itself — a re-driven
+        # write must stay capturable exactly in the migration windows
+        # this path exists for — and records its own hop span, follow-
+        # up hop included
+        traced = client.trace_sample > 0 and trace_mod.hit(
+            rid, client.trace_sample
+        )
+        hop = trace_mod.new_span_id() if traced else None
+        extra: dict = {"rid": rid}
+        if traced:
+            extra["trace"] = {"forced": True, "span": hop}
+        # ONE hop span covers the whole re-drive window, recorded in
+        # the finally so a FAILED re-drive (the case a post-mortem
+        # needs most) still shows up — _rpc's finally discipline
+        hop_attrs = {"method": method, "addr": client.address,
+                     "kind": "redrive", "code": "FAILED"}
+        try:
+            for i in range(30):
+                time.sleep(min(1.0, 0.05 * (i + 1)))
+                try:
+                    resp = client._call_once(
+                        method, self._hop_req(client, req, keys, extra)
                     )
-                    if src_seq is not None:
-                        follow["src_seq"] = int(src_seq)
-                    return target._call_once(method, follow)
-                raise
-            except grpc.RpcError as e:
-                last = e
-                continue
-        raise last
+                    hop_attrs["code"] = "OK"
+                    return resp
+                except protocol.BloomServiceError as e:
+                    last = e
+                    hop_attrs["code"] = e.code
+                    if e.code == "MIGRATE_FORWARD_FAILED":
+                        if e.details.get("src_seq") is not None:
+                            src_seq = e.details["src_seq"]
+                        continue  # install in flight — keep re-driving
+                    if e.code in ("MOVED", "ASK"):
+                        # the handoff finalized mid-re-drive: land the
+                        # SAME rid + src_seq on the new owner (its
+                        # gate/dedup absorbs a record that already made
+                        # it across)
+                        target = self._client_for(e.details["addr"])
+                        follow = self._hop_req(
+                            target, req, keys, {**extra, "asking": True}
+                        )
+                        if src_seq is not None:
+                            follow["src_seq"] = int(src_seq)
+                        resp = target._call_once(method, follow)
+                        hop_attrs.update(
+                            addr=target.address, kind="redrive-follow",
+                            code="OK",
+                        )
+                        return resp
+                    raise
+                except grpc.RpcError as e:
+                    last = e
+                    hop_attrs["code"] = "UNAVAILABLE"
+                    continue
+            raise last
+        finally:
+            if traced:
+                trace_mod.record_span(
+                    "client.hop", rid=rid, span=hop, start=w0,
+                    duration_s=time.perf_counter() - t0, attrs=hop_attrs,
+                )
 
     # -- keyed operations (the BloomClient surface, routed) -------------------
 
@@ -430,6 +467,50 @@ class ClusterClient:
                 "epoch": self.epoch,
                 "ranges": slots_mod.ranges_of(self._slot_owner),
             }
+
+    def trace(self, rid: Optional[str] = None) -> dict:
+        """Cross-shard trace assembly (ISSUE 15): merge this process's
+        own client spans with ``TraceGet`` answers from every shard
+        (primaries AND their configured replicas), then follow the
+        trace ids the returned spans introduce — a coalescer flush span
+        links the rid but its children (kernel phases, barrier) and the
+        replica applies of the merged record live under the FLUSH trace
+        id, one fan-out round away. Returns ``{rid, spans, roots,
+        components}`` — ``components`` from :func:`tpubloom.obs.trace.
+        assemble`; ONE component is the healthy single-call shape."""
+        rid = rid or self.last_rid
+        if not rid:
+            return {"rid": None, "spans": [], "roots": [], "components": []}
+        merged: dict = {
+            (s.get("rid"), s.get("span")): s
+            for s in trace_mod.get_trace(rid)
+        }
+        pending, done = {rid}, set()
+        # bounded discovery: rid -> linked flush traces -> (nothing new)
+        for _round in range(3):
+            fresh = pending - done
+            if not fresh:
+                break
+            for tid in sorted(fresh):
+                done.add(tid)
+                for client in self._unique_shard_clients():
+                    for s in client.trace_get_fan(tid):
+                        merged[(s.get("rid"), s.get("span"))] = s
+                        if s.get("rid"):
+                            pending.add(s["rid"])
+                        for link in s.get("links") or ():
+                            if link.get("rid"):
+                                pending.add(link["rid"])
+        spans = sorted(
+            merged.values(), key=lambda s: (s.get("start") or 0.0)
+        )
+        tree = trace_mod.assemble(spans)
+        return {
+            "rid": rid,
+            "spans": spans,
+            "roots": tree["roots"],
+            "components": tree["components"],
+        }
 
     def _unique_shard_clients(self) -> list:
         """One client per distinct owner address in the adopted map

@@ -60,6 +60,8 @@ import grpc
 from tpubloom import faults
 from tpubloom.cluster import slots as slots_mod
 from tpubloom.obs import counters as _counters
+from tpubloom.obs import flight as obs_flight
+from tpubloom.obs import trace as obs_trace
 from tpubloom.server import protocol
 
 log = logging.getLogger("tpubloom.cluster")
@@ -101,6 +103,10 @@ def migrate_slot(service, slot: int, target: str) -> dict:
             f"slot {slot} is owned by {owner!r}, not this node",
             details={"slot": slot, "addr": owner},
         )
+    # flight recorder (ISSUE 15): migrations are exactly the lifecycle
+    # events a post-mortem of a rebalance gone wrong needs sequenced
+    obs_flight.note("migration", slot=int(slot), target=target,
+                    stage="start")
     # 1. mark both sides (idempotent on re-drive; the epoch stamp lets
     # an up-to-date target refuse a STALE source's re-opened handoff)
     cluster.set_slot(
@@ -145,6 +151,9 @@ def migrate_slot(service, slot: int, target: str) -> dict:
             log.exception("retiring migrated filter %r failed", name)
     _counters.incr("cluster_migrations_completed")
     _counters.incr("cluster_filters_migrated", len(names))
+    obs_flight.note("migration", slot=int(slot), target=target,
+                    stage="finalized", epoch=int(new_epoch),
+                    filters=len(names))
     log.info(
         "slot %d migrated to %s at epoch %d (%d filter(s), %d snapshot(s), "
         "%d tail record(s))",
@@ -272,7 +281,11 @@ def forward_op(service, method: str, req: dict, resp: dict) -> dict:
     if resp.get("repl_seq") is not None:
         fwd["src_seq"] = int(resp["repl_seq"])
     try:
-        cluster.call(target, method, fwd, timeout=FORWARD_TIMEOUT_S)
+        # the dual-write hop is part of the request's latency story —
+        # a child span names the target so "where did my write spend
+        # 30ms" has an answer during migration windows (ISSUE 15)
+        with obs_trace.span("cluster.forward", target=target):
+            cluster.call(target, method, fwd, timeout=FORWARD_TIMEOUT_S)
     except (grpc.RpcError, protocol.BloomServiceError) as e:
         _counters.incr("cluster_forward_failures")
         details = {"applied": True, "target": target}

@@ -41,6 +41,7 @@ import threading
 from tpubloom import faults
 from tpubloom.ha.topology import EpochStore
 from tpubloom.obs import counters as _counters
+from tpubloom.obs import flight as obs_flight
 
 log = logging.getLogger("tpubloom.ha")
 
@@ -144,6 +145,11 @@ def promote_to_primary(service, *, repl_log_dir=None, epoch=None) -> dict:
         service.primary_address = None
         _counters.incr("ha_role_transitions")
         _counters.incr("ha_promotions")
+        # flight recorder (ISSUE 15): role flips are the spine of any
+        # failover post-mortem (note() under the promote lock only
+        # touches obs.counters — the declared service.promote ->
+        # obs.counters edge, same as the incrs above)
+        obs_flight.note("role_change", role="primary", epoch=int(new_epoch))
         _role_gauges(service)
         log.info(
             "promoted to primary: epoch %d, adopted seq %d, log %s (%s)",
@@ -253,6 +259,10 @@ def become_replica(service, primary_address: str, *, epoch=None) -> dict:
         if was_primary:
             _counters.incr("ha_demotions")
             service.metrics.count("ha_demotions")
+        obs_flight.note(
+            "role_change", role="replica", primary=primary_address,
+            epoch=int(service.epoch), was_primary=bool(was_primary),
+        )
         _role_gauges(service)
         log.info(
             "now replicating from %s (epoch %d, cursor %s, was_primary=%s)",

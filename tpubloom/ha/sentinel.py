@@ -57,6 +57,7 @@ import grpc
 from tpubloom import faults
 from tpubloom.ha.topology import Topology
 from tpubloom.obs import counters as _counters
+from tpubloom.obs import flight as obs_flight
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
 from tpubloom.utils import locks
@@ -776,6 +777,12 @@ class Sentinel:
             self._notify_topology()
             self.failovers += 1
             _counters.incr("sentinel_failovers")
+            # flight recorder (ISSUE 15): the completed election is the
+            # anchor event every failover post-mortem is built around
+            obs_flight.note(
+                "election", epoch=int(epoch), winner=winner,
+                old_primary=old_primary, survivors=len(survivors),
+            )
             log.warning(
                 "failover epoch %d: promoted %s (cursor %s); re-pointing "
                 "%d survivor(s)",

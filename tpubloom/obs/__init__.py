@@ -23,8 +23,14 @@ Pieces (each importable on its own, stdlib-only except where noted):
   the server's counters, latency/phase histograms, per-filter and
   checkpoint gauges, and the global counters.
 * :mod:`tpubloom.obs.httpd` — the background HTTP thread serving
-  ``GET /metrics`` (and ``/healthz``), enabled by the server's
-  ``--metrics-port`` flag.
+  ``GET /metrics`` (plus ``/healthz``, ``/trace?rid=`` and
+  ``/flight``), enabled by the server's ``--metrics-port`` flag.
+* :mod:`tpubloom.obs.trace` — distributed request tracing (ISSUE 15):
+  a Dapper-style span ring keyed on the client rid, behind the
+  server's ``--trace-sample`` knob, served by the ``TraceGet`` RPC.
+* :mod:`tpubloom.obs.flight` — the flight recorder (ISSUE 15): a
+  bounded lock-free ring of lifecycle events dumped to JSON on
+  SIGTERM / fatal / Health-DEGRADED flips and on demand.
 
 Request correlation: the gRPC client stamps every request with a ``rid``
 (``BloomClient.last_rid``); the server threads it into

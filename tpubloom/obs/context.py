@@ -45,6 +45,17 @@ from typing import Iterator, Optional
 
 _tls = threading.local()
 
+#: Set by :func:`tpubloom.obs.trace.configure` (ISSUE 15): when the
+#: trace ring is armed, fresh request contexts carry an event buffer so
+#: phase timers double as child spans; disarmed (the default) they
+#: carry None and the hot path pays one falsy check per phase.
+_trace_capture = False
+
+
+def set_trace_capture(on: bool) -> None:
+    global _trace_capture
+    _trace_capture = bool(on)
+
 
 def new_rid() -> str:
     """16-hex-char request id; cheap, collision-safe at slowlog scale."""
@@ -52,9 +63,14 @@ def new_rid() -> str:
 
 
 class RequestContext:
-    """Per-request accumulator: id, batch size, phase durations."""
+    """Per-request accumulator: id, batch size, phase durations — plus,
+    with tracing armed, the buffered child-span events and the capture
+    decision :mod:`tpubloom.obs.trace` commits at finish."""
 
-    __slots__ = ("method", "rid", "batch", "summary", "phases", "started_at")
+    __slots__ = (
+        "method", "rid", "batch", "summary", "phases", "started_at",
+        "trace_events", "trace_armed", "trace_span", "trace_parent",
+    )
 
     def __init__(self, method: str, rid: Optional[str] = None):
         self.method = method
@@ -63,6 +79,12 @@ class RequestContext:
         self.summary = ""
         self.phases: dict[str, float] = {}
         self.started_at = time.time()
+        #: (name, wall start, duration, attrs, is_phase) child events,
+        #: or None when tracing is off (zero per-phase overhead)
+        self.trace_events: Optional[list] = [] if _trace_capture else None
+        self.trace_armed = False
+        self.trace_span: Optional[str] = None
+        self.trace_parent: Optional[str] = None
 
     def add_phase(self, name: str, seconds: float) -> None:
         # += : a phase may run more than once per request (e.g. kernel
@@ -101,8 +123,16 @@ def phase(name: str) -> Iterator[None]:
     if ctx is None:
         yield
         return
+    events = ctx.trace_events
+    w0 = time.time() if events is not None else 0.0
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        ctx.add_phase(name, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        ctx.add_phase(name, dt)
+        if events is not None:
+            # ISSUE 15: the phase timer doubles as a child span —
+            # committed as phase.<name> under the request's root span
+            # when the request is captured (trace.commit_children)
+            events.append((name, w0, dt, None, True))

@@ -37,6 +37,19 @@ class MetricsServer:
             has_exemplars_knob = False
 
         class Handler(BaseHTTPRequestHandler):
+            def _send_body(self, body: bytes, content_type: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, obj) -> None:
+                self._send_body(
+                    json.dumps(obj, indent=1, default=str).encode(),
+                    "application/json",
+                )
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path, _, query = self.path.partition("?")
                 if path == "/metrics":
@@ -56,20 +69,39 @@ class MetricsServer:
                         log.exception("metrics render failed")
                         self.send_error(500, "metrics render failed")
                         return
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_body(body, CONTENT_TYPE)
                 elif path == "/healthz":
-                    body = json.dumps({"ok": True}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_json({"ok": True})
+                elif path == "/trace":
+                    # ISSUE 15: the per-node trace view — the spans this
+                    # process recorded for one rid (plus flush spans
+                    # that LINK it), same data as the TraceGet RPC
+                    from urllib.parse import parse_qs
+
+                    from tpubloom.obs import trace as trace_mod
+
+                    rid = (parse_qs(query).get("rid") or [""])[0]
+                    if not rid:
+                        self.send_error(400, "try /trace?rid=<request id>")
+                        return
+                    self._send_json(
+                        {
+                            "rid": rid,
+                            "enabled": trace_mod.enabled(),
+                            "spans": trace_mod.get_trace(rid),
+                        }
+                    )
+                elif path == "/flight":
+                    # ISSUE 15: the on-demand flight-recorder view —
+                    # the same ring a SIGTERM/fatal/DEGRADED-flip dump
+                    # writes to the state dir
+                    from tpubloom.obs import flight as flight_mod
+
+                    self._send_json({"events": flight_mod.snapshot()})
                 else:
-                    self.send_error(404, "try /metrics or /healthz")
+                    self.send_error(
+                        404, "try /metrics, /healthz, /trace or /flight"
+                    )
 
             def log_message(self, fmt, *args):  # scrapes are chatty; route to logging
                 log.debug("metrics http: " + fmt, *args)

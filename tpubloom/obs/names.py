@@ -54,6 +54,8 @@ COUNTERS = (
     "delete_dedup_hits",
     "faults_injected",
     "filters_created",
+    "flight_dumps_written",
+    "flight_events_recorded",
     "geometry_probe_compiles",
     "geometry_probe_demotions",
     "ha_demotions",
@@ -119,6 +121,8 @@ COUNTERS = (
     "storage_hydrations_shed",
     "storage_hydrations_total",
     "storage_warm_demotions",
+    "trace_requests_sampled",
+    "trace_spans_recorded",
 )
 
 #: Last-write-wins levels (rendered as Prometheus ``gauge``).
@@ -148,6 +152,7 @@ GAUGES = (
     "storage_resident_filters",
     "storage_warm_bytes",
     "storage_warm_filters",
+    "trace_buffer_spans",
     "wait_blocked_current",
 )
 
@@ -175,6 +180,80 @@ PHASE_DYNAMIC_PREFIXES = (
      "(tpubloom.parallel.sharded, ROADMAP 1(c)) — kernel_shard<i> is "
      "the time from fence start to device i's completion; the first "
      "jump names the straggler"),
+)
+
+#: Distributed-tracing span vocabulary (ISSUE 15 — the phase-registry
+#: pattern extended to spans). Every literal name passed to
+#: ``trace.span(...)`` / ``trace.record_span(...)`` must be declared
+#: here; the lint's ``trace-registry`` check closes both directions so
+#: ``TraceGet`` trees, the ``/trace`` view and dashboards keep naming
+#: the same stages. Semantics:
+#:
+#: * ``client.hop``      — one client-side RPC attempt window (Python
+#:   ``BloomClient._rpc`` incl. every cluster MOVED/ASK hop and
+#:   migration re-drive; attrs name the method + dialed address)
+#: * ``ingest.park``     — a request waiting in the coalescer's queue
+#:   for its flush to complete (child of the request's root span)
+#: * ``ingest.flush``    — ONE coalesced flush (its own trace id;
+#:   ``links`` name every parked request's root span, so N-to-1
+#:   batching stays explainable; kernel phases + the barrier are its
+#:   children)
+#: * ``barrier.wait``    — the synchronous-replication commit barrier
+#:   (direct path: child of the request; coalesced: child of the flush)
+#: * ``cluster.forward`` — a migration dual-write forward to the slot's
+#:   import target
+#: * ``repl.apply``      — a replica applying one op-log record, stamped
+#:   with the ORIGIN rid (attrs carry seq/method/filter)
+#: * ``storage.hydrate`` / ``storage.evict`` — tenant paging transitions
+#:   on the faulting request's path (ISSUE 14)
+SPANS = (
+    "client.hop",
+    "ingest.park",
+    "ingest.flush",
+    "barrier.wait",
+    "cluster.forward",
+    "repl.apply",
+    "storage.hydrate",
+    "storage.evict",
+)
+
+#: Span names minted at runtime, prefix-declared like the phase/metric
+#: dynamic prefixes: the pattern and where it comes from.
+SPAN_DYNAMIC_PREFIXES = (
+    ("rpc.", "per-RPC server root spans — rpc.<Method> is the whole "
+     "handler window (tpubloom.obs.trace.finish_request; attrs carry "
+     "filter/slot/batch/seq/verdict code)"),
+    ("phase.", "the obs.context phase timers promoted to child spans "
+     "— phase.<name> for every name in PHASES/PHASE_DYNAMIC_PREFIXES "
+     "(tpubloom.obs.trace.commit_children)"),
+)
+
+#: Flight-recorder event vocabulary (ISSUE 15): the lifecycle events
+#: ``tpubloom.obs.flight.note`` records — rare, structured, dumped to
+#: JSON on SIGTERM / fatal / DEGRADED-flip / on demand. Same
+#: trace-registry closure as SPANS.
+#:
+#: * ``shed``           — an admission or hydration-quota shed
+#: * ``breaker``        — a client circuit-breaker state flip
+#: * ``role_change``    — promotion / demotion (attrs: role, epoch)
+#: * ``election``       — a sentinel failover election completed
+#: * ``migration``      — a slot migration started / finalized
+#: * ``eviction``       — the storage tier paged a tenant out
+#: * ``health``         — the Health status flipped (attrs: status,
+#:   reasons) — the DEGRADED flip also triggers a dump
+#: * ``oplog_failstop`` — an op-log append error fail-stopped writes
+#:   (also triggers a dump: this is the "fatal" case)
+#: * ``drain``          — SIGTERM/SIGINT drain began (dump follows)
+EVENTS = (
+    "shed",
+    "breaker",
+    "role_change",
+    "election",
+    "migration",
+    "eviction",
+    "health",
+    "oplog_failstop",
+    "drain",
 )
 
 #: Shapes of names minted at runtime (not literal-checkable): the

@@ -95,6 +95,20 @@ class Slowlog:
             else:
                 heapq.heappush(self._heap, (duration_s, entry["id"], entry))
 
+    def would_record(self, duration_s: float) -> bool:
+        """Whether a request of this duration would enter the ring —
+        the tracing layer's "slowlog-worthy" predicate (ISSUE 15: slow
+        requests are ALWAYS captured, sampled or not). Asked BEFORE
+        :meth:`record` so the answer is not perturbed by the entry
+        itself."""
+        if duration_s < self.threshold_s or self.capacity <= 0:
+            return False
+        with self._lock:
+            return (
+                len(self._heap) < self.capacity
+                or duration_s > self._heap[0][0]
+            )
+
     def entries(self, n: Optional[int] = None) -> list[dict]:
         """Slowest first; at most ``n`` entries (all by default)."""
         with self._lock:

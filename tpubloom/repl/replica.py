@@ -44,6 +44,7 @@ import msgpack
 
 from tpubloom import faults
 from tpubloom.obs import counters as _counters
+from tpubloom.obs import trace as obs_trace
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
 from tpubloom.utils import locks
@@ -547,7 +548,40 @@ class ReplicaApplier:
                 # seq gap against the local log: only a full resync can
                 # restore a coherent prefix — never paper over a gap
                 raise FullResyncNeeded("<oplog>", reason=str(e))
+        # distributed tracing (ISSUE 15): the apply is stamped with the
+        # ORIGIN rid — the same trace id the client's hop, the server's
+        # handler and the coalescer's flush used — so a cross-node
+        # assembly shows where the record landed. Captured when the
+        # record carries the forced flag (_log_op stamps it for sampled
+        # requests and traced flushes) or this node's own deterministic
+        # rid sample hits.
+        traced = obs_trace.enabled() and bool(rec.get("rid"))
+        parent = None
+        if traced:
+            req_trace = (rec.get("req") or {}).get("trace")
+            if isinstance(req_trace, dict):
+                traced = bool(req_trace.get("forced"))
+                p = req_trace.get("span")
+                parent = p if isinstance(p, str) else None
+            else:
+                traced = obs_trace.hit(rec["rid"])
+        w0 = time.time() if traced else 0.0
+        t0 = time.perf_counter() if traced else 0.0
         applied = self.service.apply_record(rec)
+        if traced:
+            obs_trace.record_span(
+                "repl.apply",
+                rid=rec["rid"],
+                parent=parent,
+                start=w0,
+                duration_s=time.perf_counter() - t0,
+                attrs={
+                    "seq": int(rec["seq"]),
+                    "method": rec.get("method"),
+                    "filter": (rec.get("req") or {}).get("name"),
+                    "applied": bool(applied),
+                },
+            )
         if applied:
             self.records_applied += 1
             _counters.incr("repl_records_applied")

@@ -85,6 +85,8 @@ import grpc
 import numpy as np
 
 from tpubloom.obs import counters as obs_counters
+from tpubloom.obs import flight as obs_flight
+from tpubloom.obs import trace as obs_trace
 from tpubloom.obs.context import new_rid
 from tpubloom.server import protocol
 from tpubloom.utils import locks
@@ -165,6 +167,12 @@ class CircuitBreaker:
     def _set_state(self, state: str) -> None:
         self._state = state
         obs_counters.set_gauge("client_breaker_state", _BREAKER_GAUGE[state])
+        # flight recorder (ISSUE 15): breaker flips are exactly the
+        # lifecycle breadcrumbs a post-mortem of a client-side outage
+        # needs (note() under the breaker lock only touches
+        # obs.counters — the declared client.breaker -> obs.counters
+        # edge, same as the gauge above)
+        obs_flight.note("breaker", state=state)
 
     def check(self, address: str) -> None:
         """Raise :class:`CircuitOpenError` while open; transition to
@@ -257,6 +265,7 @@ class BloomClient:
         sentinels: Optional[Sequence[str]] = None,
         topology: Optional[dict] = None,
         encoding: str = "auto",
+        trace_sample: float = 0.0,
     ):
         """``replicas`` + ``read_preference="replica"`` route QueryBatch
         traffic round-robin over read replicas (writes always hit
@@ -290,6 +299,16 @@ class BloomClient:
                 f"got {encoding!r}"
             )
         self.encoding = encoding
+        #: distributed tracing (ISSUE 15): fraction of logical calls
+        #: this client traces (deterministic per rid). A traced call
+        #: records a local ``client.hop`` span and stamps ``trace =
+        #: {"forced": true, "span": <hop id>}`` on the wire so every
+        #: server hop captures its tree under the same rid regardless
+        #: of server-side sampling. 0.0 (the default) adds NO wire
+        #: fields and no per-call work.
+        self.trace_sample = float(trace_sample)
+        if self.trace_sample > 0:
+            obs_trace.ensure_enabled()
         #: None = not yet probed for THIS connection; True/False once a
         #: Health answer settled whether the server speaks `fixed`
         self._fixed_negotiated: Optional[bool] = None
@@ -586,6 +605,46 @@ class BloomClient:
         req = {**req, "rid": rid}
         if self.epoch is not None and method in protocol.MUTATING_METHODS:
             req["epoch"] = self.epoch
+        # distributed tracing (ISSUE 15): a traced call records one
+        # local client.hop span per _rpc (cluster redirect follow-ups
+        # call _rpc again → sibling hops under the same rid) and forces
+        # server-side capture via the wire trace field. Untraced calls
+        # take the exact pre-ISSUE-15 path: no field, no timers.
+        # TraceGet itself is exempt — assembling a trace must not
+        # inject lookup spans into (or evict spans out of) the very
+        # rings it is reading.
+        if (
+            method == "TraceGet"
+            or self.trace_sample <= 0
+            or not obs_trace.hit(rid, self.trace_sample)
+        ):
+            return self._rpc_attempts(method, req)
+        hop = obs_trace.new_span_id()
+        req["trace"] = {"forced": True, "span": hop}
+        w0, t0 = time.time(), time.perf_counter()
+        code = "OK"
+        try:
+            return self._rpc_attempts(method, req)
+        except protocol.BloomServiceError as e:
+            code = e.code
+            raise
+        except grpc.RpcError:
+            code = "UNAVAILABLE"
+            raise
+        finally:
+            obs_trace.record_span(
+                "client.hop",
+                rid=rid,
+                span=hop,
+                start=w0,
+                duration_s=time.perf_counter() - t0,
+                attrs={"method": method, "addr": self.address, "code": code},
+            )
+
+    def _rpc_attempts(self, method: str, req: dict) -> dict:
+        """The retry/heal loop of one logical call (split from
+        :meth:`_rpc` so the tracing wrapper brackets every hop)."""
+        rid = req["rid"]
         routed = self._try_replica(method, req)
         if routed is not None:
             return routed
@@ -1042,6 +1101,34 @@ class BloomClient:
         parity. Entries carry the rid this client stamped on each call."""
         req = {"n": n} if n is not None else {}
         return self._rpc("SlowlogGet", req)["entries"]
+
+    def trace_get(self, rid: Optional[str] = None) -> list:
+        """Distributed-tracing lookup (ISSUE 15): the spans the
+        CONNECTED node recorded for one rid (default: this client's
+        last call), plus coalescer flush spans that link it. Assemble
+        cross-node views with ``ClusterClient.trace``."""
+        resp = self._rpc("TraceGet", {"trace_rid": rid or self.last_rid})
+        return resp.get("spans") or []
+
+    def trace_get_fan(self, rid: str) -> list:
+        """Best-effort ``TraceGet`` against the primary AND every
+        configured replica channel — a replica's ``repl.apply`` spans
+        live in ITS ring, not the primary's. Unreachable nodes are
+        skipped (a trace lookup must never fail a post-mortem)."""
+        spans: list = []
+        try:
+            spans.extend(self.trace_get(rid))
+        except (grpc.RpcError, protocol.BloomServiceError):
+            pass
+        for _addr, _ch, calls in list(self._replicas):
+            try:
+                resp = self._call_once(
+                    "TraceGet", {"trace_rid": rid}, calls
+                )
+                spans.extend(resp.get("spans") or [])
+            except (grpc.RpcError, protocol.BloomServiceError):
+                continue
+        return spans
 
     def slowlog_reset(self) -> int:
         """Clear the server slowlog; returns how many entries dropped."""

@@ -69,7 +69,9 @@ from typing import Optional
 import numpy as np
 
 from tpubloom import faults
+from tpubloom.obs import context as obs
 from tpubloom.obs import counters as obs_counters
+from tpubloom.obs import trace as obs_trace
 from tpubloom.ops.sweep import InFlight
 from tpubloom.utils import locks
 
@@ -117,12 +119,16 @@ class _Entry:
     __slots__ = (
         "req", "rid", "nkeys", "nbytes", "rows", "keys",
         "want_presence", "replay_unsafe", "min_replicas",
-        "timeout_ms", "enq_t", "event", "resp", "error",
+        "timeout_ms", "enq_t", "event", "resp", "error", "trace",
     )
 
     def __init__(self, req: dict, *, rows, keys, replay_unsafe: bool):
         self.req = req
         self.rid = req.get("rid")
+        #: (rid, root span id) when the parking request is traced —
+        #: what the flush span LINKS so N-to-1 batching stays
+        #: explainable (ISSUE 15); None on the untraced hot path
+        self.trace = obs_trace.request_ref()
         self.rows = rows          # np.uint8[n, width] (fixed encoding) or None
         self.keys = keys          # list of key bytes/str, or None
         self.nkeys = int(rows.shape[0]) if rows is not None else len(keys)
@@ -299,7 +305,9 @@ class IngestCoalescer:
             obs_counters.set_gauge("ingest_parked_current", self._parked_keys)
             self._cond.notify_all()
         budget = self._entry_budget(entry)
-        if not entry.event.wait(timeout=budget):
+        with obs_trace.span("ingest.park", filter=name, op=kind):
+            done = entry.event.wait(timeout=budget)
+        if not done:
             raise protocol.BloomServiceError(
                 "INTERNAL",
                 f"coalesced {method} did not complete within {budget:.0f}s",
@@ -407,6 +415,45 @@ class IngestCoalescer:
     # -- flush ---------------------------------------------------------------
 
     def _flush(self, name: str, kind: str, entries: list) -> None:
+        """One flush, optionally traced (ISSUE 15): when any parked
+        request is captured, the flush runs under ITS OWN trace id —
+        the ``ingest.flush`` root span LINKS every traced request's
+        root span, the request context it opens turns the kernel
+        phases (host_prep/h2d/kernel) into the flush span's children,
+        and the merged op-log record is minted under the flush rid
+        (``_log_op`` reads ``obs.current_rid()``), so replica applies
+        of the merged record join the same trace. Untraced flushes
+        take the exact pre-ISSUE-15 path."""
+        refs = [e.trace for e in entries if e.trace is not None]
+        if not (obs_trace.enabled() and refs):
+            return self._flush_inner(name, kind, entries, None)
+        frid = obs.new_rid()
+        froot = obs_trace.new_span_id()
+        with obs.request(f"ingest.{kind}", rid=frid) as rctx:
+            rctx.trace_armed = True
+            rctx.trace_span = froot
+            try:
+                return self._flush_inner(name, kind, entries, (frid, froot))
+            finally:
+                obs_trace.record_span(
+                    "ingest.flush",
+                    rid=frid,
+                    span=froot,
+                    start=rctx.started_at,
+                    duration_s=max(0.0, time.time() - rctx.started_at),
+                    attrs={
+                        "filter": name,
+                        "op": kind,
+                        "requests": len(entries),
+                        "keys": int(sum(e.nkeys for e in entries)),
+                    },
+                    links=[{"rid": r, "span": s} for r, s in refs],
+                )
+                obs_trace.commit_children(rctx, froot)
+
+    def _flush_inner(
+        self, name: str, kind: str, entries: list, ftrace
+    ) -> None:
         from tpubloom.server import protocol
 
         service = self._service
@@ -430,8 +477,12 @@ class IngestCoalescer:
                 service.metrics.count("ingest_clear_flushes")
             self._retry_evicted(name, mf, {
                 "query": lambda m: self._flush_query(m, entries),
-                "delete": lambda m: self._flush_delete(name, m, entries),
-                "clear": lambda m: self._flush_clear(name, m, entries),
+                "delete": lambda m: self._flush_delete(
+                    name, m, entries, ftrace
+                ),
+                "clear": lambda m: self._flush_clear(
+                    name, m, entries, ftrace
+                ),
             }[kind])
             return
         # op-sorted flushes (ISSUE 11 satellite): ONE presence-wanting
@@ -467,7 +518,7 @@ class IngestCoalescer:
             try:
                 self._retry_evicted(
                     name, mf,
-                    lambda m: self._flush_insert(name, m, part),
+                    lambda m: self._flush_insert(name, m, part, ftrace),
                 )
             except BaseException as e:  # noqa: BLE001 — waiters must wake
                 log.exception("ingest flush part for %r failed", name)
@@ -559,7 +610,7 @@ class IngestCoalescer:
                 "_coalesced": True,
             })
 
-    def _flush_insert(self, name: str, mf, entries: list) -> None:
+    def _flush_insert(self, name: str, mf, entries: list, ftrace=None) -> None:
         service = self._service
         rows, keys = self._demote_wide_rows(mf, *self._merge(entries))
         want_presence = any(e.want_presence for e in entries)
@@ -629,7 +680,7 @@ class IngestCoalescer:
             presence = np.asarray(presence)  # fence + D2H, outside the lock
 
         def finalize():
-            self._finalize_insert(entries, seq, presence)
+            self._finalize_insert(entries, seq, presence, ftrace)
 
         payload = (entries, finalize, self._needs_barrier(entries, seq))
         if out is not None:
@@ -640,7 +691,7 @@ class IngestCoalescer:
         else:
             self._settle(payload, None)
 
-    def _flush_delete(self, name: str, mf, entries: list) -> None:
+    def _flush_delete(self, name: str, mf, entries: list, ftrace=None) -> None:
         """Delete-only flush (ISSUE 12 satellite — the PR-10 seam): ONE
         ``delete_batch`` launch over the merged keys + ONE op-log append
         + ONE commit barrier, demuxed per request exactly like inserts.
@@ -681,11 +732,11 @@ class IngestCoalescer:
         service.metrics.count("keys_deleted", sum(e.nkeys for e in entries))
 
         def finalize():
-            self._finalize_insert(entries, seq, None)
+            self._finalize_insert(entries, seq, None, ftrace)
 
         self._settle((entries, finalize, self._needs_barrier(entries, seq)), None)
 
-    def _flush_clear(self, name: str, mf, entries: list) -> None:
+    def _flush_clear(self, name: str, mf, entries: list, ftrace=None) -> None:
         """Clear-only flush: the whole parked run collapses to ONE
         ``clear()`` + ONE op-log append + ONE barrier (clears are
         idempotent, so N concurrent clears ARE one clear — no dedup
@@ -707,7 +758,7 @@ class IngestCoalescer:
             return
 
         def finalize():
-            self._finalize_insert(entries, seq, None)
+            self._finalize_insert(entries, seq, None, ftrace)
 
         self._settle((entries, finalize, self._needs_barrier(entries, seq)), None)
 
@@ -770,7 +821,7 @@ class IngestCoalescer:
         with self._cond:
             self._cond.notify_all()
 
-    def _finalize_insert(self, entries, seq, presence) -> None:
+    def _finalize_insert(self, entries, seq, presence, ftrace=None) -> None:
         """Demux one applied flush back to its parked requests: dedup
         caching, presence slices, and ONE commit barrier whose achieved
         count settles every request's own quorum. Self-protective: any
@@ -780,7 +831,7 @@ class IngestCoalescer:
         from tpubloom.server import protocol
 
         try:
-            self._finalize_insert_inner(entries, seq, presence)
+            self._finalize_insert_inner(entries, seq, presence, ftrace)
         except BaseException as e:  # noqa: BLE001 — waiters must wake
             log.exception("ingest finalize failed")
             err = (
@@ -793,11 +844,11 @@ class IngestCoalescer:
                 if not entry.event.is_set():
                     entry.complete(error=err)
 
-    def _finalize_insert_inner(self, entries, seq, presence) -> None:
+    def _finalize_insert_inner(self, entries, seq, presence, ftrace=None) -> None:
         from tpubloom.server import protocol
 
         service = self._service
-        acked, barrier_error = self._flush_barrier(entries, seq)
+        acked, barrier_error = self._flush_barrier(entries, seq, ftrace)
         off = 0
         for entry in entries:
             resp: dict = {"ok": True, "n": entry.nkeys}
@@ -845,11 +896,13 @@ class IngestCoalescer:
             resp["_coalesced"] = True
             entry.complete(resp=resp)
 
-    def _flush_barrier(self, entries, seq):
+    def _flush_barrier(self, entries, seq, ftrace=None):
         """ONE ``wait_acked`` for the whole flush, at the strongest
         quorum any entry demanded and the longest budget any entry
         brought; returns ``(achieved ack count, barrier error or
-        None)``."""
+        None)``. With the flush traced, the barrier records its own
+        ``barrier.wait`` span under the flush root (it runs on the
+        completer thread, after the flush context is gone)."""
         from tpubloom.server import protocol
 
         service = self._service
@@ -864,21 +917,34 @@ class IngestCoalescer:
         barrier_req: dict = {"min_replicas": needed}
         if budgets:
             barrier_req["min_replicas_timeout_ms"] = max(budgets)
+        w0, t0 = time.time(), time.perf_counter()
         try:
-            resp = service.commit_barrier(barrier_req, {"repl_seq": seq})
-            return int(resp.get("acked_replicas") or 0), None
-        except protocol.BloomServiceError as e:
-            if e.code != "NOT_ENOUGH_REPLICAS":
-                raise
-            acked = int(e.details.get("acked") or 0)
-            # the fail-fast (fewer connected than the max quorum) path
-            # reports 0 — weaker per-entry quorums may still be met
-            max_age = (service.min_replicas_max_lag_ms or 0) / 1000.0
-            acked = max(
-                acked,
-                service.repl_sessions.count_acked(seq, max_age=max_age),
-            )
-            return acked, e
+            try:
+                resp = service.commit_barrier(barrier_req, {"repl_seq": seq})
+                return int(resp.get("acked_replicas") or 0), None
+            except protocol.BloomServiceError as e:
+                if e.code != "NOT_ENOUGH_REPLICAS":
+                    raise
+                acked = int(e.details.get("acked") or 0)
+                # the fail-fast (fewer connected than the max quorum)
+                # path reports 0 — weaker per-entry quorums may still
+                # be met
+                max_age = (service.min_replicas_max_lag_ms or 0) / 1000.0
+                acked = max(
+                    acked,
+                    service.repl_sessions.count_acked(seq, max_age=max_age),
+                )
+                return acked, e
+        finally:
+            if ftrace is not None:
+                obs_trace.record_span(
+                    "barrier.wait",
+                    rid=ftrace[0],
+                    parent=ftrace[1],
+                    start=w0,
+                    duration_s=time.perf_counter() - t0,
+                    attrs={"seq": int(seq), "needed": int(needed)},
+                )
 
     def _fallback_direct(self, entries: list, method: str = "InsertBatch") -> None:
         """Migration-window fallback: re-drive each parked request

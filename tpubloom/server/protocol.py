@@ -64,6 +64,7 @@ METHODS = (
     "Checkpoint",
     "SlowlogGet",
     "SlowlogReset",
+    "TraceGet",
     "Promote",
     "ReplicaOf",
     "Wait",
@@ -120,6 +121,20 @@ MUTATING_METHODS = frozenset(
 #: and IS logged locally, only the quorum ack is missing, so a retry
 #: under the same rid re-waits on the same record instead of
 #: re-applying.
+
+#: Distributed tracing (ISSUE 15): ``TraceGet`` ``{trace_rid}`` answers
+#: ``{rid, enabled, spans: [...]}`` — every span THIS node recorded for
+#: that trace id (the client rid), plus any coalescer flush span that
+#: LINKS it and that flush trace's children. The lookup key travels as
+#: ``trace_rid`` because the bare ``rid`` field is the per-call
+#: transport correlation id clients stamp on every request (raw callers
+#: that stamp none may use ``rid``). Unsheddable control plane:
+#: the trace of a slow request is most needed exactly when the node is
+#: drowning. A request MAY carry ``trace = {"forced": true, "span":
+#: <parent span id>}`` to force capture regardless of the server's
+#: ``--trace-sample`` rate and to parent the server's root span under
+#: the client's hop span; with tracing off servers ignore the field and
+#: clients stamp none (the off path is wire-identical to pre-ISSUE-15).
 
 #: HA control-plane RPCs (ISSUE 4): ``Promote`` (replica→primary,
 #: ``REPLICAOF NO ONE`` parity) and ``ReplicaOf`` (re-point/demote,

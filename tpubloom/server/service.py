@@ -147,6 +147,8 @@ from tpubloom.obs import counters as obs_counters
 from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.obs import context as obs
+from tpubloom.obs import flight as obs_flight
+from tpubloom.obs import trace as obs_trace
 from tpubloom.obs.slowlog import Slowlog, summarize_request
 from tpubloom.cluster import migrate as cluster_migrate
 from tpubloom.cluster import node as cluster_node
@@ -212,8 +214,12 @@ class _Managed:
 #: The cluster verbs (ISSUE 9) are control plane like the HA verbs: a
 #: shed ClusterSlots blinds clients mid-redirect storm, and a shed
 #: migration hop wedges a rebalance exactly when load made it urgent.
+#: TraceGet (ISSUE 15) joins the unsheddable control plane for the same
+#: reason as Health: the trace of a slow request is most needed exactly
+#: while the node is overloaded, and the lookup is a cheap in-memory
+#: ring read holding no device buffers.
 UNSHEDDABLE = frozenset(
-    {"Health", "ListFilters", "SlowlogGet", "SlowlogReset",
+    {"Health", "ListFilters", "SlowlogGet", "SlowlogReset", "TraceGet",
      "Promote", "ReplicaOf",
      "ClusterSlots", "ClusterSetSlot", "MigrateSlot", "MigrateInstall"}
 )
@@ -268,6 +274,7 @@ class BloomService:
         cluster=None,
         coalesce=None,
         storage=None,
+        trace_sample=None,
     ):
         """``sink_factory(config) -> sink|None`` decides where each filter
         checkpoints (None disables persistence for that filter).
@@ -288,6 +295,16 @@ class BloomService:
         ``min_replicas_max_lag_ms`` — timeout answers
         ``NOT_ENOUGH_REPLICAS`` (Redis ``NOREPLICAS``). Requests may
         demand a STRONGER per-call quorum via ``min_replicas``."""
+        #: distributed tracing (ISSUE 15): a float arms the process
+        #: trace ring at that deterministic per-rid sample rate (0.0 =
+        #: only forced / slowlog-worthy requests); None (the default)
+        #: keeps tracing fully off — no wire fields, no per-request
+        #: buffering, no measurable overhead
+        if trace_sample is not None:
+            obs_trace.configure(sample=float(trace_sample))
+        #: last Health status answered — the flight recorder dumps on
+        #: the SERVING -> DEGRADED flip (ISSUE 15)
+        self._last_health_status = "SERVING"
         self._filters: dict[str, _Managed] = {}
         self._lock = locks.named_lock("service.registry")
         self._sink_factory = sink_factory or (lambda config: None)
@@ -529,6 +546,11 @@ class BloomService:
             self._last_shed_time = time.time()
             retry_ms = self._bump_shed_pressure()
         self.metrics.count("requests_shed")
+        # flight recorder (ISSUE 15): sheds are the first lifecycle
+        # signal a post-mortem wants — noted outside the admit lock
+        obs_flight.note(
+            "shed", method=method, code=shed_code, retry_after_ms=retry_ms
+        )
         return protocol.error_response(
             shed_code, shed_msg, details={"retry_after_ms": retry_ms}
         )
@@ -911,6 +933,15 @@ class BloomService:
                 # durably lost.)
                 mf.applied_seq = max(mf.applied_seq, hint)
             return None
+        tref = obs_trace.request_ref()
+        if tref is not None:
+            # trace propagation through the log (ISSUE 15): replicas
+            # and migration tail-replays capture this record's apply
+            # regardless of their own sample rate, parented under the
+            # committing request's (or flush's) root span. Handlers
+            # ignore the extra key on replay; the copy keeps the
+            # caller's dict untouched.
+            req = {**req, "trace": {"forced": True, "span": tref[1]}}
         try:
             seq = self.oplog.append(method, req, rid=obs.current_rid())
         except Exception as e:
@@ -924,6 +955,14 @@ class BloomService:
                 "op log append failed for %s — write path fail-stopped",
                 method,
             )
+            # the "fatal" flight-recorder case (ISSUE 15): the process
+            # is about to stop accepting writes — dump the lifecycle
+            # ring NOW, best-effort (note touches only the declared
+            # filter.op -> obs.counters edge; the dump's file IO is
+            # acceptable here — this path already does log IO under
+            # the same lock, and it runs once, on the way down)
+            obs_flight.note("oplog_failstop", method=method, error=repr(e))
+            obs_flight.dump("fatal")
             raise
         if mf is not None:
             mf.applied_seq = seq
@@ -1287,8 +1326,25 @@ class BloomService:
             status = "DEGRADED"
         else:
             status = "SERVING"
+        # flight recorder (ISSUE 15): health flips are lifecycle
+        # events, and the SERVING -> DEGRADED flip is one of the
+        # moments a post-mortem needs the ring ON DISK — the process
+        # may be about to get killed by its orchestrator. The flip
+        # check-and-set runs under the admit lock (taken right below
+        # anyway) so concurrent Health probes agree on ONE flip — one
+        # note, one dump; the note/dump themselves run outside it.
         with self._admit_lock:
             in_flight = self._in_flight
+            prev = self._last_health_status
+            flipped = status != prev
+            self._last_health_status = status
+        if flipped:
+            obs_flight.note(
+                "health", status=status, previous=prev,
+                reasons=list(reasons),
+            )
+            if status == "DEGRADED":
+                obs_flight.dump("degraded")
         resp = {
             "ok": True,
             "status": status,
@@ -2065,6 +2121,29 @@ class BloomService:
         """Redis ``SLOWLOG RESET`` parity."""
         return {"ok": True, "cleared": self.slowlog.reset()}
 
+    def TraceGet(self, req: dict) -> dict:
+        """Distributed-tracing lookup (ISSUE 15): every span THIS node
+        recorded for one trace id (= the client rid), plus coalescer
+        flush spans that LINK it and their children. Cross-node
+        assembly is the client's job (``ClusterClient.trace``).
+
+        The looked-up id travels as ``trace_rid`` — the bare ``rid``
+        field is the TRANSPORT correlation id every client stamps per
+        call, which would otherwise clobber the lookup key; raw callers
+        that stamp no correlation id may still use ``rid``."""
+        rid = req.get("trace_rid") or req.get("rid")
+        if not isinstance(rid, str) or not rid:
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT",
+                "TraceGet needs {trace_rid: <request id>}",
+            )
+        return {
+            "ok": True,
+            "rid": rid,
+            "enabled": obs_trace.enabled(),
+            "spans": obs_trace.get_trace(rid),
+        }
+
     def gauge_snapshot(self) -> list:
         """Per-filter gauge readings for the Prometheus exposition: each
         entry = {filter, stats, shard_fill?, checkpoint?}. Reads run under
@@ -2196,6 +2275,23 @@ def _wrap(service: BloomService, method_name: str):
                         rctx.rid = req["rid"]
                     rctx.batch = protocol.batch_size(req)
                     rctx.summary = summarize_request(method_name, req)
+                    # distributed tracing (ISSUE 15): decide capture
+                    # now that the client rid (and any propagated trace
+                    # context) is known — forced by the wire field, or
+                    # the deterministic per-rid sample; slowlog-worthy
+                    # requests are additionally captured at finish.
+                    # TraceGet never traces itself: an assembly's
+                    # lookup fan-out must not pollute (or evict from)
+                    # the ring it is reading.
+                    tmeta = req.get("trace")
+                    if not isinstance(tmeta, dict):
+                        tmeta = None
+                    if method_name != "TraceGet":
+                        obs_trace.arm_request(
+                            rctx,
+                            forced=bool(tmeta and tmeta.get("forced")),
+                            parent=tmeta.get("span") if tmeta else None,
+                        )
                     name = req.get("name")
                     req_name = name if isinstance(name, str) else None
                     if service.storage is not None and req_name is not None:
@@ -2338,7 +2434,8 @@ def _wrap(service: BloomService, method_name: str):
                         and method_name in protocol.MUTATING_METHODS
                         and resp.get("ok")
                     ):
-                        resp = service.commit_barrier(req, resp)
+                        with obs_trace.span("barrier.wait"):
+                            resp = service.commit_barrier(req, resp)
                         if service.cluster is not None:
                             # dual-write window (ISSUE 9): a mutating op
                             # on a migrating filter must land on the
@@ -2373,6 +2470,32 @@ def _wrap(service: BloomService, method_name: str):
             service.metrics.observe_rpc(
                 method_name, duration_s, rctx.phases, rid=rctx.rid
             )
+            if obs_trace.enabled() and method_name != "TraceGet":
+                # commit the request's span tree (ISSUE 15): sampled/
+                # forced requests always, and slowlog-worthy ones even
+                # unsampled — asked BEFORE the slowlog entry lands so
+                # the predicate is not perturbed by this request itself
+                code = "OK"
+                if isinstance(resp, dict) and not resp.get("ok", False):
+                    code = (resp.get("error") or {}).get("code", "UNKNOWN")
+                tattrs: dict = {"method": method_name, "code": code}
+                if req_name:
+                    tattrs["filter"] = req_name
+                    if service.cluster is not None:
+                        tattrs["slot"] = cluster_slots.key_slot(req_name)
+                if rctx.batch:
+                    tattrs["batch"] = int(rctx.batch)
+                if isinstance(resp, dict) and resp.get("repl_seq") is not None:
+                    tattrs["seq"] = int(resp["repl_seq"])
+                obs_trace.finish_request(
+                    rctx, duration_s, attrs=tattrs,
+                    # the slowlog probe (a lock round trip) only
+                    # matters when the request is NOT already armed
+                    slow=(
+                        not rctx.trace_armed
+                        and service.slowlog.would_record(duration_s)
+                    ),
+                )
             service.slowlog.record(
                 method=method_name,
                 duration_s=duration_s,
@@ -2743,6 +2866,26 @@ def main(argv: Optional[list] = None) -> None:
         "waits for the replica quorum before giving up "
         f"(default {DEFAULT_MIN_REPLICAS_MAX_LAG_MS})",
     )
+    parser.add_argument(
+        "--trace-sample",
+        type=float,
+        default=None,
+        metavar="R",
+        help="distributed tracing (ISSUE 15): capture span trees for "
+        "this deterministic per-rid fraction of requests (0.0 = only "
+        "forced/slowlog-worthy ones) into the bounded per-node ring "
+        "served by TraceGet and /trace?rid=. Omit to disable tracing "
+        "entirely (the default: no wire fields, no overhead)",
+    )
+    parser.add_argument(
+        "--flight-dir",
+        default=None,
+        metavar="DIR",
+        help="flight-recorder dump directory (default: the op-log dir "
+        "or checkpoint dir, else $TPUBLOOM_FLIGHT_DIR); lifecycle-event "
+        "dumps land here on SIGTERM, fatal write-path errors and Health "
+        "DEGRADED flips",
+    )
     args = parser.parse_args(argv)
     if args.min_replicas_to_write and not args.repl_log_dir:
         parser.error("--min-replicas-to-write requires --repl-log-dir")
@@ -2804,6 +2947,19 @@ def main(argv: Optional[list] = None) -> None:
             "ingestion coalescer: flush at %d keys / %dus",
             args.coalesce_max_keys, args.coalesce_max_wait_us,
         )
+    # flight recorder (ISSUE 15): dumps land beside the durable state
+    # (or wherever CI's TPUBLOOM_FLIGHT_DIR points) — post-mortems of
+    # chaos failures stop depending on scraping a live /metrics
+    import os as _os
+
+    flight_dir = (
+        args.flight_dir
+        or _os.environ.get(obs_flight.DUMP_DIR_ENV)
+        or args.repl_log_dir
+        or ckpt_dir
+    )
+    if flight_dir:
+        obs_flight.configure(dump_dir=flight_dir)
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
@@ -2817,6 +2973,7 @@ def main(argv: Optional[list] = None) -> None:
         cluster=cluster_state,
         coalesce=coalesce,
         storage=storage_config,
+        trace_sample=args.trace_sample,
     )
     if oplog is not None:
         stats = service.replay_oplog()
@@ -2880,6 +3037,11 @@ def main(argv: Optional[list] = None) -> None:
         signal.signal(sig, lambda signum, frame: stop.set())
     stop.wait()
     log.info("drain: refusing new work, finishing in-flight requests...")
+    # flight recorder (ISSUE 15): dump FIRST — the drain itself may
+    # wedge, and the whole point is having the lifecycle ring on disk
+    # when the process stops being scrapeable
+    obs_flight.note("drain", grace_s=float(args.drain_grace))
+    obs_flight.dump("sigterm")
     service.begin_drain()
     # Notice window BEFORE the port closes: grpc's stop() rejects new RPCs
     # at the transport, so without this pause clients would only ever see

@@ -80,6 +80,8 @@ from typing import Optional
 from tpubloom import checkpoint as ckpt
 from tpubloom import faults
 from tpubloom.obs import counters as obs_counters
+from tpubloom.obs import flight as obs_flight
+from tpubloom.obs import trace as obs_trace
 from tpubloom.utils import locks
 
 log = logging.getLogger("tpubloom.storage")
@@ -517,7 +519,12 @@ class TenantStore:
                 # sync (bookkeeping races the publish by a few
                 # instructions) — fall through and loop
             if start:
-                return self._hydrate(name)
+                # the hydration runs on the faulting request's thread —
+                # a storage.hydrate child span names exactly where a
+                # cold-tenant request spent its time (ISSUE 15; no-op
+                # without an armed request context)
+                with obs_trace.span("storage.hydrate", tenant=name):
+                    return self._hydrate(name)
             if shed_msg is not None:
                 # quota shed (PR-2 shed path): the same adaptive
                 # retry_after_ms signal the in-flight cap emits, so a
@@ -526,6 +533,10 @@ class TenantStore:
                 hint = svc.shed_hint()
                 obs_counters.incr("storage_hydrations_shed")
                 svc.metrics.count("requests_shed")
+                obs_flight.note(
+                    "shed", source="hydration", tenant=name,
+                    retry_after_ms=hint,
+                )
                 raise protocol.BloomServiceError(
                     "RESOURCE_EXHAUSTED", shed_msg,
                     details={"retry_after_ms": hint, "tenant": name},
@@ -658,7 +669,10 @@ class TenantStore:
                 victim.busy_done = threading.Event()
                 self._update_gauges_locked()
             try:
-                self._evict(victim.name)
+                # evictions run on the thread that grew residency — the
+                # span shows up under the request that paid for them
+                with obs_trace.span("storage.evict", tenant=victim.name):
+                    self._evict(victim.name)
                 evicted += 1
             except BaseException as exc:  # noqa: BLE001 — eviction must fail soft
                 # an aborted eviction (injected storage.evict fault, a
@@ -791,6 +805,10 @@ class TenantStore:
             self._trim_warm_locked()
             self._update_gauges_locked()
         obs_counters.incr("storage_evictions_total")
+        obs_flight.note(
+            "eviction", tenant=name, applied_seq=int(applied),
+            landed_seq=None if landed is None else int(landed),
+        )
 
     def _trim_warm_locked(self) -> None:
         """Warm-pool budget: demote the coldest fully-checkpoint-covered

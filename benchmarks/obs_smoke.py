@@ -16,7 +16,12 @@ What it checks (the ISSUE-1 acceptance list, end to end):
   1.0 must stay within a generous factor of the off path — re-measured
   once like the other perf gates), and the sampling-ON path produces a
   span tree (``rpc.InsertBatch`` root + phase children) retrievable by
-  rid via ``TraceGet``.
+  rid via ``TraceGet``;
+* crash-forensics black box (ISSUE 16): disabled by default (the
+  disabled path is the same one-truthy-check note path the phases
+  above measure), and with the mmap'd rings armed the write-through +
+  slowlog-worthy span spills stay within the same generous overhead
+  bound — plus the spilled ring decodes cleanly via ``read_node``.
 
 Run directly (``python benchmarks/obs_smoke.py`` — prints one JSON line)
 or via tier-1 (``tests/test_obs.py::test_obs_smoke`` imports
@@ -151,6 +156,44 @@ def run_smoke() -> dict:
         finally:
             trace_mod.reset_for_tests()
 
+        # -- crash-forensics black box (ISSUE 16) ---------------------
+        # disabled is the default and the disabled path is the same
+        # one-truthy-check-per-note path the earlier phases already
+        # measured; the gate here bounds the ENABLED cost: mmap'd
+        # write-through flight notes plus forced/slow span spills.
+        from tpubloom.obs import blackbox as bb_mod
+
+        assert not bb_mod.enabled(), "black box must be off by default"
+        bb_dir = tempfile.mkdtemp(prefix="tpubloom-obs-smoke-bb-")
+        try:
+            bb_off_rate = measure(client, b"bf0")
+            assert bb_mod.configure(bb_dir, node={"addr": "smoke"})
+            # sample 0.0 arms the ring without sampling anything: only
+            # the slow-probe path captures — and a freshly reset slowlog
+            # makes the first timed batches all slowlog-worthy, so the
+            # window measures real spills, not an idle ring
+            trace_mod.configure(sample=0.0)
+            service.slowlog.reset()
+            bb_on_rate = measure(client, b"bn0")
+            if bb_on_rate < 0.5 * bb_off_rate:
+                trace_mod.reset_for_tests()
+                bb_off_rate = measure(client, b"bf1")
+                trace_mod.configure(sample=0.0)
+                service.slowlog.reset()
+                bb_on_rate = measure(client, b"bn1")
+            assert bb_on_rate >= 0.4 * bb_off_rate, (
+                f"black-box overhead out of bounds: on={bb_on_rate:.1f}/s "
+                f"vs off={bb_off_rate:.1f}/s"
+            )
+            bb_mod.sync()
+            node = bb_mod.read_node(bb_dir)
+            assert node["spans"], "slowlog-worthy spans must have spilled"
+            assert node["meta"].get("pid") == os.getpid()
+            assert not node["skipped"], "a live ring must decode cleanly"
+        finally:
+            trace_mod.reset_for_tests()
+            bb_mod.reset_for_tests()
+
         return {
             "ok": True,
             "metrics_families": len(families),
@@ -165,6 +208,10 @@ def run_smoke() -> dict:
             "trace_on_rate_per_s": round(on_rate, 1),
             "trace_overhead_ratio": round(on_rate / off_rate, 3),
             "trace_spans_sampled": len(spans),
+            "blackbox_off_rate_per_s": round(bb_off_rate, 1),
+            "blackbox_on_rate_per_s": round(bb_on_rate, 1),
+            "blackbox_overhead_ratio": round(bb_on_rate / bb_off_rate, 3),
+            "blackbox_spans_spilled": len(node["spans"]),
         }
     finally:
         metrics_server.close()

@@ -1,0 +1,576 @@
+"""ISSUE 16: crash-forensics black box — mmap'd flight/trace rings that
+survive SIGKILL, plus the fleet post-mortem CLI.
+
+Covers the tentpole end to end:
+
+* the **mapped ring**: append/decode roundtrip, oldest-first wraparound,
+  reattach resuming the seq space under the FILE's geometry;
+* the **torn-tail discipline** (the op-log framing promise): corrupting
+  or truncating the last record at EVERY byte boundary of its frame
+  loses exactly that record — the decoder skips it, never misreads it,
+  and every earlier record still decodes;
+* the **writer module**: write-through from ``flight.note``, span
+  spills, oversized-record degradation (attrs dropped before the record
+  is), monotone epoch stamping, disabled-by-default;
+* the **satellites**: ``trace.assemble`` synthesizing a shared root
+  over a multi-hop forest; sentinel election RPC spans under one
+  election rid; ``ClusterClient.trace`` slot-hinted fan-out;
+  ``Slowlog.would_record`` threaded through the replica apply path;
+* the **CLI**: two nodes' rings + an op-log segment merged into one
+  epoch-then-wall-clock fleet timeline, ``--json`` and ``--rid``;
+* the **acceptance**: a real subprocess primary SIGKILLed under acked
+  load leaves rings the CLI decodes into a timeline carrying the killed
+  node's final flight events AND the in-flight rid's spans AND the
+  op-log seq the rid committed at.
+
+Armed under the lock tracker + lock-order manifest like the other chaos
+modules — the black box must stay LOCK-FREE (its write path runs under
+filter.op / service.promote / sentinel.state locks).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import msgpack
+import pytest
+
+from tpubloom import faults
+from tpubloom.obs import blackbox, flight, trace
+from tpubloom.obs.slowlog import Slowlog
+from tpubloom.repl import record as repl_record
+from tpubloom.server.client import BloomClient
+
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
+
+
+@pytest.fixture(autouse=True)
+def _blackbox_isolation():
+    trace.reset_for_tests()
+    flight.reset_for_tests()
+    blackbox.reset_for_tests()
+    faults.reset()
+    yield
+    trace.reset_for_tests()
+    flight.reset_for_tests()
+    blackbox.reset_for_tests()
+    faults.reset()
+
+
+def _body(i):
+    return msgpack.packb({"i": i, "pad": "x" * 20}, use_bin_type=True)
+
+
+# -- the mapped ring ---------------------------------------------------------
+
+
+def test_ring_roundtrip_and_wraparound(tmp_path):
+    path = str(tmp_path / "r.ring")
+    ring = blackbox.MappedRing(path, slot_size=96, nslots=4)
+    for i in range(6):
+        assert ring.append(_body(i))
+    ring.close()
+    decoded = blackbox.read_ring(path)
+    assert decoded["geometry"] == {
+        "version": blackbox.VERSION, "slot_size": 96, "nslots": 4,
+    }
+    # 6 appends into 4 slots: the oldest two were overwritten
+    assert [r["seq"] for r in decoded["records"]] == [2, 3, 4, 5]
+    assert [r["i"] for r in decoded["records"]] == [2, 3, 4, 5]
+    assert decoded["skipped"] == 0
+
+
+def test_reattach_resumes_seq_under_file_geometry(tmp_path):
+    path = str(tmp_path / "r.ring")
+    ring = blackbox.MappedRing(path, slot_size=96, nslots=4)
+    for i in range(3):
+        assert ring.append(_body(i))
+    ring.close()
+    # reattach with DIFFERENT (wrong) defaults: the file's geometry must
+    # win, and the seq space must resume past the pre-crash history
+    ring = blackbox.MappedRing(path, slot_size=512, nslots=64)
+    assert (ring.slot_size, ring.nslots) == (96, 4)
+    assert ring.append(_body(99))
+    ring.close()
+    records = blackbox.read_ring(path)["records"]
+    assert [r["seq"] for r in records] == [0, 1, 2, 3]
+    assert records[-1]["i"] == 99
+
+
+def test_torn_tail_every_byte_loses_exactly_that_record(tmp_path):
+    """THE satellite-5 test: flip/truncate the LAST record at every byte
+    boundary of its frame — the decoder must skip exactly that record
+    (whole or skipped, never a misread) and keep every earlier one."""
+    path = str(tmp_path / "r.ring")
+    ring = blackbox.MappedRing(path, slot_size=96, nslots=4)
+    for i in range(3):
+        assert ring.append(_body(i))
+    ring.close()
+    with open(path, "rb") as f:
+        clean = f.read()
+    frame_len = blackbox.FRAME_HEADER + len(_body(2))
+    off = blackbox.HEADER_LEN + 2 * 96  # seq 2 lives in slot 2
+    baseline = blackbox.decode_ring(clean)
+    assert [r["seq"] for r in baseline["records"]] == [0, 1, 2]
+
+    for i in range(frame_len):  # corrupt each frame byte in turn
+        torn = bytearray(clean)
+        torn[off + i] ^= 0xFF
+        decoded = blackbox.decode_ring(bytes(torn))
+        assert [r["seq"] for r in decoded["records"]] == [0, 1], (
+            f"flipping frame byte {i} must lose exactly record 2"
+        )
+        assert decoded["skipped"] == 1, f"byte {i} must count as torn"
+
+    for i in range(frame_len):  # truncate at each boundary (a torn tail)
+        decoded = blackbox.decode_ring(clean[: off + i])
+        assert [r["seq"] for r in decoded["records"]] == [0, 1], (
+            f"truncating at frame byte {i} must lose exactly record 2"
+        )
+        assert decoded["skipped"] <= 1
+
+
+def test_oversized_record_degrades_then_drops(tmp_path):
+    assert blackbox.configure(
+        str(tmp_path), flight_slots=8, flight_slot_size=96,
+        trace_slots=8, trace_slot_size=96,
+    )
+    # attrs too big for the slot: the record survives WITHOUT them
+    flight.configure(capacity=16)
+    blackbox.note_event(
+        {"ts": 1.0, "kind": "shed", "attrs": {"blob": "y" * 200}}
+    )
+    node = blackbox.read_node(str(tmp_path))
+    shed = [e for e in node["events"] if e.get("kind") == "shed"]
+    assert len(shed) == 1
+    assert shed[0]["truncated"] is True and "attrs" not in shed[0]
+    # un-slimmable oversize: dropped, counted, never a crash
+    blackbox.note_event({"ts": 1.0, "kind": "z" * 200})
+    node = blackbox.read_node(str(tmp_path))
+    assert not any(e.get("kind", "").startswith("z") for e in node["events"])
+
+
+# -- the writer module -------------------------------------------------------
+
+
+def test_disabled_by_default_and_write_through(tmp_path):
+    assert not blackbox.enabled()
+    flight.configure(capacity=16)
+    flight.note("shed", rid="r-off")  # disarmed: a no-op write-through
+    assert blackbox.read_node(str(tmp_path)) is None
+
+    assert blackbox.configure(str(tmp_path), node={"addr": "n1:1"})
+    assert blackbox.enabled()
+    blackbox.set_node_meta(role="primary", epoch=4)
+    blackbox.set_node_meta(epoch=2)  # epoch is monotone: stays 4
+    flight.note("shed", rid="r-on")  # armed: rides flight.note unchanged
+    trace.configure(sample=1.0)
+    trace.record_span(
+        "repl.apply", rid="r-on", start=5.0, duration_s=0.1, spill=True
+    )
+    trace.record_span(  # spill=False stays in the volatile ring only
+        "repl.apply", rid="r-volatile", start=6.0, duration_s=0.1
+    )
+    node = blackbox.read_node(str(tmp_path))
+    assert node["label"] == "n1:1"
+    assert node["meta"]["role"] == "primary" and node["meta"]["ep"] == 4
+    assert node["meta"]["pid"] == os.getpid()
+    kinds = [e["kind"] for e in node["events"]]
+    assert kinds == ["shed"]
+    assert [s["rid"] for s in node["spans"]] == ["r-on"]
+    assert node["skipped"] == 0
+
+
+def test_cli_merges_fleet_timeline_with_oplog_correlation(tmp_path, capsys):
+    # node A: epoch-1 primary with an op log that committed rid r-1
+    dir_a = tmp_path / "node-a"
+    assert blackbox.configure(str(dir_a), node={"addr": "a:1"})
+    blackbox.set_node_meta(role="primary", epoch=1)
+    flight.configure(capacity=16)
+    flight.note("boot", role="primary", epoch=1, addr="a:1")
+    trace.configure(sample=1.0)
+    trace.record_span(
+        "rpc.InsertBatch", rid="r-1", start=100.0, duration_s=0.2,
+        attrs={"filter": "f"}, spill=True,
+    )
+    blackbox.sync()
+    seg = repl_record.encode_record(
+        {"seq": 7, "method": "InsertBatch", "rid": "r-1",
+         "req": {"name": "f"}, "ts": 100.1}
+    ) + repl_record.encode_record(
+        {"seq": 8, "method": "InsertBatch", "rid": "r-other",
+         "req": {"name": "f"}, "ts": 100.2}
+    )
+    (dir_a / "oplog.00000000000000000007.seg").write_bytes(seg)
+    blackbox.reset_for_tests()
+    trace.reset_for_tests()
+
+    # node B: epoch-2 replica whose records must sort AFTER epoch 1
+    # despite EARLIER wall clock (skewed clocks are the normal case)
+    dir_b = tmp_path / "node-b"
+    assert blackbox.configure(str(dir_b), node={"addr": "b:1"})
+    blackbox.set_node_meta(role="replica", epoch=2)
+    flight.configure(capacity=16)
+    flight.note("role_change", role="replica", epoch=2)
+    trace.configure(sample=1.0)
+    trace.record_span(
+        "repl.apply", rid="r-1", start=50.0, duration_s=0.05, spill=True
+    )
+    blackbox.reset_for_tests()
+    trace.reset_for_tests()
+
+    rc = blackbox.main([str(dir_a), str(dir_b), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert {n["label"] for n in out["nodes"]} == {"a:1", "b:1"}
+    eps = [e["ep"] for e in out["timeline"]]
+    assert eps == sorted(eps), "fleet order is epoch-first"
+    oplog = [e for e in out["timeline"] if e["type"] == "oplog"]
+    # only rids the rings mention correlate — r-other stays out
+    assert [e["rid"] for e in oplog] == ["r-1"]
+    assert oplog[0]["oplog_seq"] == 7
+    span_nodes = {
+        (e["name"], e["node"])
+        for e in out["timeline"] if e["type"] == "span"
+    }
+    assert span_nodes == {("rpc.InsertBatch", "a:1"), ("repl.apply", "b:1")}
+
+    # --rid focuses spans but keeps lifecycle events for context
+    rc = blackbox.main([str(dir_a), str(dir_b), "--json", "--rid", "r-1"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert all(
+        e["type"] == "event" or e.get("rid") == "r-1"
+        for e in out["timeline"]
+    )
+    assert any(e["type"] == "event" for e in out["timeline"])
+
+    # the human rendering holds the same facts
+    rc = blackbox.main([str(dir_a), str(dir_b)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "node a:1" in text and "node b:1" in text
+    assert "OPLOG seq=7" in text and "EVENT boot" in text
+
+    rc = blackbox.main([str(tmp_path / "nowhere")])
+    assert rc == 2
+
+
+# -- satellite 1: assemble synthesizes the shared root -----------------------
+
+
+def test_assemble_synthesizes_shared_root_for_multi_hop_forest():
+    trace.configure(sample=1.0)
+    h1 = trace.record_span("client.hop", rid="r-m", start=1.0,
+                           duration_s=0.1, attrs={"addr": "a:1"})
+    h2 = trace.record_span("client.hop", rid="r-m", start=1.2,
+                           duration_s=0.3, attrs={"addr": "b:1"})
+    trace.record_span("rpc.InsertBatch", rid="r-m", parent=h2,
+                      start=1.25, duration_s=0.2)
+    spans = trace.get_trace("r-m")
+    # without the rid hint: an honest two-root forest
+    plain = trace.assemble(spans)
+    assert len(plain["components"]) == 2 and plain.get("synthetic") is None
+    # with it: ONE tree under a synthetic client.call root
+    tree = trace.assemble(spans, rid="r-m")
+    synth = tree["synthetic"]
+    assert synth["name"] == "client.call"
+    assert synth["attrs"] == {"synthesized": True, "hops": 2}
+    assert synth["start"] == 1.0
+    assert synth["duration_s"] == pytest.approx(0.5)
+    assert tree["roots"] == [synth["span"]]
+    assert len(tree["components"]) == 1
+    assert tree["parent"][h1] == synth["span"]
+    assert tree["parent"][h2] == synth["span"]
+    # a single-root trace stays untouched — no synthetic noise
+    trace.reset_for_tests()
+    trace.configure(sample=1.0)
+    trace.record_span("client.hop", rid="r-s", start=1.0, duration_s=0.1)
+    one = trace.assemble(trace.get_trace("r-s"), rid="r-s")
+    assert one.get("synthetic") is None and len(one["roots"]) == 1
+
+
+# -- satellite 2: sentinel election spans ------------------------------------
+
+
+def test_sentinel_election_records_rpc_spans(tmp_path, monkeypatch):
+    from tpubloom.ha.sentinel import Sentinel
+    from tpubloom.ha.topology import Topology
+
+    trace.configure(sample=1.0)
+    assert blackbox.configure(str(tmp_path))
+    sentinel = Sentinel("old:1", ["p1:1"], listen="127.0.0.1:0")
+    sentinel.topology = Topology(epoch=3, primary="old:1",
+                                 replicas=["r1:1", "old:1"])
+    calls = []
+
+    def _peer(peer, method, req, timeout=None):
+        calls.append(("peer", peer, method))
+        return {"granted": True} if method == "VoteDown" else {}
+
+    def _node(addr, method, req, timeout=None):
+        calls.append(("node", addr, method))
+        if method == "Health":
+            return {"replication": {"cursor": 9}}
+        return {"ok": True}
+
+    monkeypatch.setattr(sentinel, "_peer", _peer)
+    monkeypatch.setattr(sentinel, "_node", _node)
+    monkeypatch.setattr(
+        sentinel, "_adopt_completed_failover", lambda: False
+    )
+    sentinel._attempt_failover()
+
+    rid = sentinel.last_election_rid
+    assert rid == f"election-4-{sentinel.sentinel_id[:8]}"
+    spans = trace.get_trace(rid)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert set(by_name) == {
+        "sentinel.vote_down", "sentinel.promote", "sentinel.topology",
+    }
+    vote = by_name["sentinel.vote_down"][0]
+    assert vote["attrs"] == {"peer": "p1:1", "epoch": 4,
+                             "ok": True, "granted": True}
+    assert by_name["sentinel.promote"][0]["attrs"]["candidate"] == "r1:1"
+    assert by_name["sentinel.promote"][0]["attrs"]["ok"] is True
+    assert by_name["sentinel.topology"][0]["attrs"]["ok"] is True
+    assert sentinel.topology.primary == "r1:1"
+    # every election span spilled: elections ARE crash forensics
+    node = blackbox.read_node(str(tmp_path))
+    assert {s["name"] for s in node["spans"]} == set(by_name)
+    assert all(s["rid"] == rid for s in node["spans"])
+
+
+# -- satellite 3: slot-hinted cross-shard trace fetch ------------------------
+
+
+class _StubShard:
+    def __init__(self, addr):
+        self.address = addr
+        self.asked = []
+
+    def trace_get_fan(self, tid):
+        self.asked.append(tid)
+        return [{"rid": tid, "span": f"s-{self.address}", "parent": None,
+                 "name": "rpc.InsertBatch", "start": 1.0,
+                 "duration_s": 0.1}]
+
+
+def _stub_cluster_client(owner_map):
+    from tpubloom.cluster.client import ClusterClient
+    from tpubloom.utils import locks
+
+    cc = ClusterClient.__new__(ClusterClient)
+    cc._lock = locks.named_lock("cluster.client")
+    cc._kwargs = {}
+    cc.last_rid = None
+    cc.epoch = 1
+    cc._slot_owner = owner_map
+    cc._shard_clients = [_StubShard("a:1"), _StubShard("b:1")]
+    cc._direct = {}
+    return cc
+
+
+def test_cluster_trace_slot_hint_skips_full_fan_out():
+    from tpubloom.cluster import slots as slots_mod
+
+    slot = slots_mod.key_slot("f1")
+    cc = _stub_cluster_client(
+        {s: ("a:1" if s == slot else "b:1") for s in range(16384)}
+    )
+    a, b = cc._shard_clients
+    out = cc.trace("r-h", name="f1")
+    assert a.asked and not b.asked, "the hint must dodge the fleet fan-out"
+    assert out["rid"] == "r-h" and out["spans"]
+    # same via an explicit slot number
+    a.asked.clear()
+    cc.trace("r-h2", slot=slot)
+    assert a.asked == ["r-h2"] and not b.asked
+    # no hint: the full fan-out still runs
+    a.asked.clear()
+    cc.trace("r-h3")
+    assert a.asked and b.asked
+
+
+def test_cluster_trace_hint_falls_back_on_clusterdown(monkeypatch):
+    cc = _stub_cluster_client({})
+    monkeypatch.setattr(cc, "refresh_slots", lambda: False)
+    out = cc.trace("r-d", slot=77)  # unmapped: CLUSTERDOWN inside
+    a, b = cc._shard_clients
+    assert a.asked and b.asked, "an unmapped slot degrades to full fan-out"
+    assert out["rid"] == "r-d"
+
+
+# -- satellite 4: slowlog-worthy replica applies -----------------------------
+
+
+def _stub_applier(slowlog):
+    from tpubloom.repl.replica import ReplicaApplier
+
+    class _Svc:
+        oplog = None
+
+        def __init__(self):
+            self.slowlog = slowlog
+
+        def apply_record(self, rec):
+            return True
+
+    a = ReplicaApplier.__new__(ReplicaApplier)
+    a.service = _Svc()
+    a.state_store = None
+    a.head_seq = 0
+    a.cursor = None
+    a._ack = None
+    a.records_applied = 0
+    a.records_skipped = 0
+    return a
+
+
+def test_replica_apply_spills_slowlog_worthy_and_forced(tmp_path):
+    assert blackbox.configure(str(tmp_path))
+    # sample 0.0: armed but nothing hits — ONLY the slow/forced paths
+    # may capture, exactly the chaos-suite configuration
+    trace.configure(sample=0.0)
+    applier = _stub_applier(Slowlog(capacity=8, threshold_s=0.0))
+    applier._handle_record(
+        {"seq": 1, "method": "InsertBatch", "rid": "r-slow",
+         "req": {"name": "f"}, "ts": time.time()}
+    )
+    spans = trace.get_trace("r-slow")
+    assert [s["name"] for s in spans] == ["repl.apply"]
+    assert spans[0]["attrs"]["applied"] is True
+
+    # forced wire flag: captured AND spilled, parented across the wire
+    applier._handle_record(
+        {"seq": 2, "method": "InsertBatch", "rid": "r-forced",
+         "req": {"name": "f", "trace": {"forced": True, "span": "abcd1234"}},
+         "ts": time.time()}
+    )
+    forced = trace.get_trace("r-forced")
+    assert forced and forced[0]["parent"] == "abcd1234"
+
+    # an apply the slowlog would NOT record stays invisible
+    fast = _stub_applier(Slowlog(capacity=8, threshold_s=3600.0))
+    fast._handle_record(
+        {"seq": 3, "method": "InsertBatch", "rid": "r-fast",
+         "req": {"name": "f"}, "ts": time.time()}
+    )
+    assert trace.get_trace("r-fast") == []
+
+    node = blackbox.read_node(str(tmp_path))
+    assert {s["rid"] for s in node["spans"]} == {"r-slow", "r-forced"}
+
+
+# -- the acceptance: SIGKILL post-mortem -------------------------------------
+
+
+_SERVER_CHILD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+
+
+def _spawn(tmp_path, script_name, args):
+    script = tmp_path / script_name
+    script.write_text(_SERVER_CHILD)
+    return subprocess.Popen(
+        [sys.executable, str(script)] + [str(a) for a in args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_child_env(),
+    )
+
+
+def test_sigkill_acceptance_postmortem_cli(tmp_path):
+    """THE acceptance run: a subprocess primary killed with SIGKILL under
+    acked load leaves mmap'd rings behind; the post-mortem CLI (run as
+    its own process — the reader needs nothing from the dead writer)
+    decodes them into a timeline carrying the killed node's final
+    flight events, the last acked rid's spilled spans, and the op-log
+    seq that rid committed at."""
+    plog = tmp_path / "primary-log"
+    port = _free_port()
+    # --trace-sample 0.0 arms tracing WITHOUT sampling: spans persist
+    # via the slowlog-worthy spill path alone — the configuration every
+    # chaos suite runs, so this asserts the worst-case capture mode
+    proc = _spawn(
+        tmp_path, "primary.py",
+        [port, tmp_path / "ckpt", "--repl-log-dir", plog,
+         "--trace-sample", "0.0"],
+    )
+    acked = []
+    try:
+        client = BloomClient(f"127.0.0.1:{port}", timeout=30.0)
+        client.wait_ready(timeout=120)
+        client.create_filter("bb", capacity=50_000, error_rate=0.01)
+        for i in range(6):
+            keys = [b"bb-%d-%06d" % (i, j) for j in range(64)]
+            assert client.insert_batch("bb", keys) == len(keys)
+            acked.append(client.last_rid)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    assert len(acked) == 6
+    cli = subprocess.run(
+        [sys.executable, "-m", "tpubloom.obs.blackbox", str(plog),
+         "--json"],
+        capture_output=True, text=True, env=_child_env(), timeout=120,
+    )
+    assert cli.returncode == 0, cli.stderr
+    out = json.loads(cli.stdout)
+    (node,) = out["nodes"]
+    assert node["meta"]["role"] == "primary"
+    assert node["meta"]["pid"] == proc.pid
+    kinds = [e["kind"] for e in node["events"]]
+    assert "boot" in kinds, "the ring must carry the node's lifecycle"
+    span_rids = {
+        s["rid"] for s in node["spans"] if s["name"] == "rpc.InsertBatch"
+    }
+    missing = [r for r in acked if r not in span_rids]
+    assert not missing, (
+        f"acked rids {missing} lost their spans to the SIGKILL"
+    )
+    oplog_rids = {
+        e["rid"] for e in out["timeline"] if e["type"] == "oplog"
+    }
+    assert set(acked) <= oplog_rids, (
+        "every acked rid must correlate to its committed op-log seq"
+    )
+
+    # the human timeline focuses on the final acked rid
+    focus = subprocess.run(
+        [sys.executable, "-m", "tpubloom.obs.blackbox", str(plog),
+         "--rid", acked[-1]],
+        capture_output=True, text=True, env=_child_env(), timeout=120,
+    )
+    assert focus.returncode == 0
+    assert f"rid={acked[-1]}" in focus.stdout
+    assert "EVENT boot" in focus.stdout

@@ -629,6 +629,10 @@ def test_migration_sigkill_acceptance(tmp_path):
     child_args = [
         _sys.executable, str(script), str(port),
         "--cluster", "--repl-log-dir", str(plog),
+        # black box armed in chaos mode (ISSUE 16): only slowlog-worthy
+        # work spills at sample 0.0 — the post-mortem below reads the
+        # rings the SIGKILL leaves behind
+        "--trace-sample", "0.0",
     ]
     # pass 1 = filter 1's probe, 2 = its install, 3 = filter 2's probe,
     # 4 = its install → the first MigrateSlot dies with one filter
@@ -674,6 +678,8 @@ def test_migration_sigkill_acceptance(tmp_path):
                 for n in names}
         for n in names:
             cc.insert_batch(n, seed[n])
+        seed_rid = cc.last_rid  # served by the source — the rid whose
+        # spilled span the post-mortem must find in the dead ring
 
         n_batches, batch_size = 16, 15
         batches = [
@@ -720,6 +726,17 @@ def test_migration_sigkill_acceptance(tmp_path):
         # ... and then the whole source process dies
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=30)
+
+        # post-mortem (ISSUE 16): the dead source's mmap'd black box
+        # still decodes — lifecycle events plus the seed write's span
+        from tpubloom.obs import blackbox as bb
+
+        node = bb.read_node(str(plog))
+        assert node is not None, "SIGKILL must leave a readable black box"
+        assert "boot" in [e["kind"] for e in node["events"]]
+        assert seed_rid in {s.get("rid") for s in node["spans"]}, (
+            "the seed write's spilled span must survive the SIGKILL"
+        )
 
         # restart (no injected faults): op-log replay restores the
         # filters AND the rid-dedup cache; the slot map (with its

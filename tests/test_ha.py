@@ -860,6 +860,10 @@ def test_failover_sigkill_acceptance(tmp_path):
     child_args = [
         _sys.executable, str(script), str(port),
         "--repl-log-dir", str(plog),
+        # black box armed in chaos mode (ISSUE 16): sample 0.0 means
+        # only slowlog-worthy/forced work spills — the worst case the
+        # post-mortem below must still decode after the SIGKILL
+        "--trace-sample", "0.0",
     ]
     proc = subprocess.Popen(
         child_args,
@@ -940,6 +944,23 @@ def test_failover_sigkill_acceptance(tmp_path):
             f"client failed to complete all batches: {len(acked)}; "
             f"errors={errors[-3:]}"
         )
+
+        # post-mortem (ISSUE 16): the SIGKILLed primary ran no handler,
+        # but its mmap'd black box survives — it must decode into the
+        # node's lifecycle and the pre-kill batches' spilled spans
+        from tpubloom.obs import blackbox as bb
+
+        node = bb.read_node(str(plog))
+        assert node is not None, "SIGKILL must leave a readable black box"
+        assert node["meta"].get("role") == "primary"
+        assert "boot" in [e["kind"] for e in node["events"]]
+        dead_rids = {s.get("rid") for s in node["spans"]}
+        pre_kill = [rid for i, rid in acked if i < 8]
+        assert pre_kill and set(pre_kill) <= dead_rids, (
+            "pre-kill acked rids must have spilled spans in the dead "
+            "primary's ring"
+        )
+        assert bb.merge_timeline([node], rid=pre_kill[-1])
 
         # the failover happened and the client followed it
         topo = fetch_topology([s.address for s in sents])

@@ -484,6 +484,9 @@ def _spawn_child(tmp_path, port):
             _sys.executable, str(script), str(port), str(tmp_path / "ckpt"),
             "--repl-log-dir", str(tmp_path / "oplog"),
             "--max-resident-filters", "2",
+            # black box armed in chaos mode (ISSUE 16): the post-mortem
+            # reads the rings the SIGKILL leaves in the oplog state dir
+            "--trace-sample", "0.0",
         ],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
@@ -504,6 +507,8 @@ def test_sigkill_during_eviction_loses_nothing(tmp_path):
     proc = _spawn_child(tmp_path, port)
     names = [f"sk-{i}" for i in range(6)]
     acked: dict = {n: [] for n in names}
+    rids: list = []  # acked insert rids, oldest first (the early ones
+    # are slowlog-worthy on a fresh server, so their spans spill)
     proc2 = None
     try:
         with BloomClient(f"127.0.0.1:{port}") as c:
@@ -524,6 +529,7 @@ def test_sigkill_during_eviction_loses_nothing(tmp_path):
                         try:
                             wc.insert_batch(n, keys)
                             acked[n].extend(keys)
+                            rids.append(wc.last_rid)
                         except Exception as e:  # noqa: BLE001
                             errors.append(repr(e))
                             return
@@ -547,6 +553,23 @@ def test_sigkill_during_eviction_loses_nothing(tmp_path):
         proc.wait(timeout=30)
         stop.set()
         t.join(timeout=10)
+
+        # post-mortem (ISSUE 16): the killed server's mmap'd black box
+        # must still decode — its boot + eviction-churn lifecycle and
+        # the earliest acked rids' spilled spans
+        from tpubloom.obs import blackbox as bb
+
+        node = bb.read_node(str(tmp_path / "oplog"))
+        assert node is not None, "SIGKILL must leave a readable black box"
+        kinds = {e["kind"] for e in node["events"]}
+        assert "boot" in kinds
+        assert "eviction" in kinds, (
+            "the paging churn's eviction events must be in the dead ring"
+        )
+        dead_rids = {s.get("rid") for s in node["spans"]}
+        assert rids and rids[0] in dead_rids, (
+            "the first acked insert's span must have spilled"
+        )
 
         # restart over the same dirs; replay must bring every acked
         # write back — exactly once

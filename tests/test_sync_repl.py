@@ -683,7 +683,10 @@ def test_quorum_acked_survives_sigkill_without_redrive(tmp_path):
     script.write_text(_SERVER_CHILD)
     proc = subprocess.Popen(
         [_sys.executable, str(script), str(port),
-         "--repl-log-dir", str(tmp_path / "primary-log")],
+         "--repl-log-dir", str(tmp_path / "primary-log"),
+         # black box armed in chaos mode (ISSUE 16): sample 0.0 spills
+         # only slowlog-worthy work — what the post-mortem below reads
+         "--trace-sample", "0.0"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
     boot = BloomClient(f"127.0.0.1:{port}")
@@ -714,9 +717,23 @@ def test_quorum_acked_survives_sigkill_without_redrive(tmp_path):
             boot.insert_batch(
                 "cnt", keys, min_replicas=1, min_replicas_timeout_ms=60_000
             )
+        last_rid = boot.last_rid
         # the last quorum-acked batch JUST returned: kill the primary NOW
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=30)
+
+        # post-mortem (ISSUE 16): the killed primary's mmap'd black box
+        # must carry its lifecycle AND the final quorum-acked batch's
+        # spilled spans — the write it acked the instant it died
+        from tpubloom.obs import blackbox as bb
+
+        node = bb.read_node(str(tmp_path / "primary-log"))
+        assert node is not None, "SIGKILL must leave a readable black box"
+        assert node["meta"].get("role") == "primary"
+        assert "boot" in [e["kind"] for e in node["events"]]
+        assert last_rid in {s.get("rid") for s in node["spans"]}, (
+            "the final quorum-acked rid's span must have spilled"
+        )
 
         _wait(
             lambda: any(s.failovers for s in sents),

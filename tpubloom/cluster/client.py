@@ -468,7 +468,13 @@ class ClusterClient:
                 "ranges": slots_mod.ranges_of(self._slot_owner),
             }
 
-    def trace(self, rid: Optional[str] = None) -> dict:
+    def trace(
+        self,
+        rid: Optional[str] = None,
+        *,
+        name: Optional[str] = None,
+        slot: Optional[int] = None,
+    ) -> dict:
         """Cross-shard trace assembly (ISSUE 15): merge this process's
         own client spans with ``TraceGet`` answers from every shard
         (primaries AND their configured replicas), then follow the
@@ -477,10 +483,28 @@ class ClusterClient:
         replica applies of the merged record live under the FLUSH trace
         id, one fan-out round away. Returns ``{rid, spans, roots,
         components}`` — ``components`` from :func:`tpubloom.obs.trace.
-        assemble`; ONE component is the healthy single-call shape."""
+        assemble`; ONE component is the healthy single-call shape.
+
+        ISSUE 16 satellites: pass ``name`` (the filter the call keyed)
+        or ``slot`` directly and the fan-out narrows to the slot's
+        owning shard — one ``TraceGet`` round trip instead of the full
+        fleet, which is what a post-mortem script chasing thousands of
+        rids needs. The hint degrades safely: an unmapped slot
+        (CLUSTERDOWN) falls back to the full fan-out. Assembly passes
+        ``rid`` through so a multi-hop MOVED/ASK/re-drive chain comes
+        back as ONE tree under a synthetic ``client.call`` root (the
+        synthetic span joins the returned ``spans``)."""
         rid = rid or self.last_rid
         if not rid:
             return {"rid": None, "spans": [], "roots": [], "components": []}
+        if slot is None and name is not None:
+            slot = slots_mod.key_slot(name)
+        hinted: Optional[list] = None
+        if slot is not None:
+            try:
+                hinted = [self._client_for(self._owner_addr(int(slot)))]
+            except protocol.BloomServiceError:
+                hinted = None  # no adopted map — full fan-out is the hint
         merged: dict = {
             (s.get("rid"), s.get("span")): s
             for s in trace_mod.get_trace(rid)
@@ -493,7 +517,12 @@ class ClusterClient:
                 break
             for tid in sorted(fresh):
                 done.add(tid)
-                for client in self._unique_shard_clients():
+                targets = (
+                    hinted
+                    if hinted is not None
+                    else self._unique_shard_clients()
+                )
+                for client in targets:
                     for s in client.trace_get_fan(tid):
                         merged[(s.get("rid"), s.get("span"))] = s
                         if s.get("rid"):
@@ -504,7 +533,9 @@ class ClusterClient:
         spans = sorted(
             merged.values(), key=lambda s: (s.get("start") or 0.0)
         )
-        tree = trace_mod.assemble(spans)
+        tree = trace_mod.assemble(spans, rid=rid)
+        if tree.get("synthetic"):
+            spans = spans + [tree["synthetic"]]
         return {
             "rid": rid,
             "spans": spans,

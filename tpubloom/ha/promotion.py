@@ -40,6 +40,7 @@ import threading
 
 from tpubloom import faults
 from tpubloom.ha.topology import EpochStore
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import counters as _counters
 from tpubloom.obs import flight as obs_flight
 
@@ -150,6 +151,9 @@ def promote_to_primary(service, *, repl_log_dir=None, epoch=None) -> dict:
         # touches obs.counters — the declared service.promote ->
         # obs.counters edge, same as the incrs above)
         obs_flight.note("role_change", role="primary", epoch=int(new_epoch))
+        # black-box node identity (ISSUE 16): post-promotion records in
+        # the mapped ring must carry the new role + epoch
+        obs_blackbox.set_node_meta(role="primary", epoch=int(new_epoch))
         _role_gauges(service)
         log.info(
             "promoted to primary: epoch %d, adopted seq %d, log %s (%s)",
@@ -263,6 +267,7 @@ def become_replica(service, primary_address: str, *, epoch=None) -> dict:
             "role_change", role="replica", primary=primary_address,
             epoch=int(service.epoch), was_primary=bool(was_primary),
         )
+        obs_blackbox.set_node_meta(role="replica", epoch=int(service.epoch))
         _role_gauges(service)
         log.info(
             "now replicating from %s (epoch %d, cursor %s, was_primary=%s)",

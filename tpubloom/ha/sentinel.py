@@ -56,8 +56,10 @@ import grpc
 
 from tpubloom import faults
 from tpubloom.ha.topology import Topology
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import counters as _counters
 from tpubloom.obs import flight as obs_flight
+from tpubloom.obs import trace as obs_trace
 from tpubloom.server import protocol
 from tpubloom.utils import crcjson
 from tpubloom.utils import locks
@@ -202,6 +204,11 @@ class Sentinel:
         self._rand = _random.Random()
         self._election_stagger = self._rand.uniform(0, failover_cooldown_s)
         self.failovers = 0
+        #: trace id of the newest election this sentinel LED (ISSUE 16
+        #: satellite): every vote/promote/topology RPC of one failover
+        #: records a span under this rid, so ``TraceGet``-style assembly
+        #: and the black-box CLI can show the election hop by hop
+        self.last_election_rid: Optional[str] = None
         self._stop = threading.Event()
         self._channels: dict = {}
         #: topology-push machinery (ISSUE 9 satellite): subscribers of
@@ -662,8 +669,19 @@ class Sentinel:
             self._last_vote_epoch = new_epoch
             self._persist_state()
         faults.fire("ha.vote")
+        # election trace id (ISSUE 16 satellite): deterministic per
+        # (epoch, sentinel), so two sentinels dueling the same epoch
+        # still produce distinguishable traces. Every RPC span of this
+        # election spills to the black box — elections are crash
+        # forensics by definition.
+        rid = f"election-{new_epoch}-{self.sentinel_id[:8]}"
+        self.last_election_rid = rid
+        tracing = obs_trace.enabled()
         votes = 1
         for peer in self.peers:
+            w0 = time.time()
+            t0 = time.perf_counter()
+            granted = ok = False
             try:
                 resp = self._peer(
                     peer,
@@ -671,9 +689,22 @@ class Sentinel:
                     {"epoch": new_epoch, "primary": primary,
                      "candidate": self.sentinel_id},
                 )
+                ok = True
+                granted = bool(resp.get("granted"))
             except grpc.RpcError:
-                continue
-            if resp.get("granted"):
+                pass
+            finally:
+                if tracing:
+                    obs_trace.record_span(
+                        "sentinel.vote_down",
+                        rid=rid,
+                        start=w0,
+                        duration_s=time.perf_counter() - t0,
+                        attrs={"peer": peer, "epoch": new_epoch,
+                               "ok": ok, "granted": granted},
+                        spill=True,
+                    )
+            if granted:
                 votes += 1
         _counters.set_gauge("sentinel_last_election_votes", votes)
         if votes < self.quorum:
@@ -689,7 +720,7 @@ class Sentinel:
             "failover epoch %d",
             self.sentinel_id, primary, votes, self.quorum, new_epoch,
         )
-        self._do_failover(new_epoch, primary)
+        self._do_failover(new_epoch, primary, rid=rid)
 
     def _verify_promoted(self, addr: str, epoch: int) -> bool:
         """Did a Promote that timed out client-side land anyway? Poll the
@@ -720,7 +751,10 @@ class Sentinel:
         cursor = repl.get("cursor")
         return int(cursor) if cursor is not None else 0
 
-    def _do_failover(self, epoch: int, old_primary: str) -> None:
+    def _do_failover(
+        self, epoch: int, old_primary: str, rid: Optional[str] = None
+    ) -> None:
+        tracing = obs_trace.enabled() and rid is not None
         with self._lock:
             candidates = [
                 a for a in self.topology.replicas if a != old_primary
@@ -739,6 +773,8 @@ class Sentinel:
             )
             return
         for cursor, winner in ranked:
+            w0 = time.time()
+            t0 = time.perf_counter()
             try:
                 resp = self._node(
                     winner,
@@ -753,12 +789,32 @@ class Sentinel:
                 if self._verify_promoted(winner, epoch):
                     resp = {"ok": True}
                 else:
+                    if tracing:
+                        obs_trace.record_span(
+                            "sentinel.promote",
+                            rid=rid,
+                            start=w0,
+                            duration_s=time.perf_counter() - t0,
+                            attrs={"candidate": winner, "epoch": epoch,
+                                   "ok": False},
+                            spill=True,
+                        )
                     log.warning(
                         "failover epoch %d: promoting %s failed (%s); "
                         "trying the next candidate",
                         epoch, winner, getattr(e, "code", lambda: e)(),
                     )
                     continue
+            if tracing:
+                obs_trace.record_span(
+                    "sentinel.promote",
+                    rid=rid,
+                    start=w0,
+                    duration_s=time.perf_counter() - t0,
+                    attrs={"candidate": winner, "epoch": epoch,
+                           "ok": bool(resp.get("ok"))},
+                    spill=True,
+                )
             if not resp.get("ok"):
                 log.warning(
                     "failover epoch %d: %s refused promotion: %s",
@@ -811,10 +867,24 @@ class Sentinel:
                 "leader": self.sentinel_id,
             }
             for peer in self.peers:
+                w0 = time.time()
+                t0 = time.perf_counter()
+                pushed = False
                 try:
                     self._peer(peer, "AnnounceTopology", announce)
+                    pushed = True
                 except grpc.RpcError:
                     pass
+                if tracing:
+                    obs_trace.record_span(
+                        "sentinel.topology",
+                        rid=rid,
+                        start=w0,
+                        duration_s=time.perf_counter() - t0,
+                        attrs={"peer": peer, "epoch": epoch,
+                               "ok": pushed},
+                        spill=True,
+                    )
             return
         log.error("failover epoch %d: every candidate refused", epoch)
 
@@ -864,6 +934,19 @@ def main(argv: Optional[list] = None) -> None:
     )
     logging.basicConfig(level=logging.INFO)
     faults.load_env()
+    if args.state_dir:
+        # crash-forensics black box (ISSUE 16): a sentinel with durable
+        # state gets durable forensics too — its election spans spill
+        # into <state-dir>/blackbox/ and the boot event anchors which
+        # process wrote them. Tracing arms at sample 0.0: only the
+        # explicit election spans record, nothing else pays.
+        obs_blackbox.configure(
+            args.state_dir, node={"addr": f"0.0.0.0:{args.port}"}
+        )
+        obs_trace.ensure_enabled()
+        obs_flight.note(
+            "boot", role="sentinel", epoch=0, addr=f"0.0.0.0:{args.port}"
+        )
     sentinel = Sentinel(
         args.watch,
         args.peers,

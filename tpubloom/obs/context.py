@@ -70,6 +70,7 @@ class RequestContext:
     __slots__ = (
         "method", "rid", "batch", "summary", "phases", "started_at",
         "trace_events", "trace_armed", "trace_span", "trace_parent",
+        "trace_forced",
     )
 
     def __init__(self, method: str, rid: Optional[str] = None):
@@ -85,6 +86,9 @@ class RequestContext:
         self.trace_armed = False
         self.trace_span: Optional[str] = None
         self.trace_parent: Optional[str] = None
+        #: the wire trace field forced capture (ISSUE 16: forced
+        #: requests spill their tree to the crash-forensics black box)
+        self.trace_forced = False
 
     def add_phase(self, name: str, seconds: float) -> None:
         # += : a phase may run more than once per request (e.g. kernel

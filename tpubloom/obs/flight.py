@@ -35,6 +35,15 @@ Dump directory resolution: :func:`configure` (the server points it at
 its state dir), else the ``TPUBLOOM_FLIGHT_DIR`` environment variable —
 which is how the CI chaos shards collect every subprocess server's
 dumps as one artifact without touching each test harness.
+
+Since ISSUE 16 the ring is also DURABLE: when
+:func:`tpubloom.obs.blackbox.configure` armed the crash-forensics black
+box (servers do it for their state dir), every :func:`note` writes
+through to an mmap'd, CRC-framed ring file that survives SIGKILL — the
+deque stays as the live view (``GET /flight``, dumps), the mapped ring
+is what a post-mortem reads out of a dead node. The write-through is
+lock-free like the deque append, so the locking contract above is
+unchanged.
 """
 
 from __future__ import annotations
@@ -47,6 +56,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import counters as obs_counters
 
 log = logging.getLogger("tpubloom.obs")
@@ -87,6 +97,12 @@ def note(kind: str, **attrs) -> None:
     if attrs:
         ev["attrs"] = attrs
     _events.append(ev)
+    # crash-forensics write-through (ISSUE 16): when the black box is
+    # armed, the event also lands in the mmap'd ring — still lock-free
+    # (atomic seq reservation + one slice assignment), so this path
+    # stays safe under every lock the docstring above names. A SIGKILL
+    # now loses at most the record being copied, not the whole ring.
+    obs_blackbox.note_event(ev)
     obs_counters.incr("flight_events_recorded")
 
 

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 #: Monotone event counts (rendered as Prometheus ``counter``).
 COUNTERS = (
+    "blackbox_records_dropped",
+    "blackbox_records_written",
     "breaker_closed",
     "breaker_opened",
     "ckpt_corrupt_detected",
@@ -206,6 +208,19 @@ PHASE_DYNAMIC_PREFIXES = (
 #:   with the ORIGIN rid (attrs carry seq/method/filter)
 #: * ``storage.hydrate`` / ``storage.evict`` — tenant paging transitions
 #:   on the faulting request's path (ISSUE 14)
+#: * ``sentinel.vote_down`` / ``sentinel.promote`` /
+#:   ``sentinel.topology`` — one failover election's RPCs (ISSUE 16
+#:   satellite): the leading sentinel records a span per peer vote
+#:   request, per Promote attempt and per AnnounceTopology push, all
+#:   under one election trace id (``Sentinel.last_election_rid``), so
+#:   an election is traceable span-by-span, not just as one flight
+#:   event. Spilled to the black box — elections are crash forensics
+#:   by definition.
+#:
+#: ``client.call`` is deliberately ABSENT from this registry: it is the
+#: synthetic shared root ``trace.assemble`` fabricates client-side so a
+#: multi-hop MOVED/ASK/re-drive call renders as one tree — it is never
+#: emitted into any ring, so it has no emit site to close over.
 SPANS = (
     "client.hop",
     "ingest.park",
@@ -215,6 +230,9 @@ SPANS = (
     "repl.apply",
     "storage.hydrate",
     "storage.evict",
+    "sentinel.vote_down",
+    "sentinel.promote",
+    "sentinel.topology",
 )
 
 #: Span names minted at runtime, prefix-declared like the phase/metric
@@ -244,6 +262,10 @@ SPAN_DYNAMIC_PREFIXES = (
 #: * ``oplog_failstop`` — an op-log append error fail-stopped writes
 #:   (also triggers a dump: this is the "fatal" case)
 #: * ``drain``          — SIGTERM/SIGINT drain began (dump follows)
+#: * ``boot``           — the process came up (attrs: role, epoch,
+#:   addr) — an aircraft recorder logs power-on; with the black box
+#:   (ISSUE 16) every state dir's ring carries at least this, so a
+#:   post-mortem can anchor "which process wrote these final events"
 EVENTS = (
     "shed",
     "breaker",
@@ -254,6 +276,7 @@ EVENTS = (
     "health",
     "oplog_failstop",
     "drain",
+    "boot",
 )
 
 #: Shapes of names minted at runtime (not literal-checkable): the

@@ -62,6 +62,7 @@ import zlib
 from collections import OrderedDict
 from typing import Iterator, Optional
 
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import context as obs_context
 from tpubloom.obs import counters as obs_counters
 from tpubloom.utils import locks
@@ -212,10 +213,15 @@ def record_span(
     parent: Optional[str] = None,
     attrs: Optional[dict] = None,
     links: Optional[list] = None,
+    spill: bool = False,
 ) -> str:
     """Record one finished span into the ring (no-op when tracing is
     off); returns the span id. ``attrs`` values must be msgpack-safe
-    scalars (the caller casts)."""
+    scalars (the caller casts). ``spill=True`` (forced or slowlog-
+    worthy spans — ISSUE 16) additionally writes the span through to
+    the crash-forensics black box's mapped trace ring, so the spans
+    explaining a crash survive the crash; the spill is lock-free and a
+    no-op when the black box is disarmed."""
     sid = span or new_span_id()
     if _sample is None:
         return sid
@@ -232,6 +238,8 @@ def record_span(
     if links:
         s["links"] = links
     _ring.record(s)
+    if spill:
+        obs_blackbox.spill_span(s)
     return sid
 
 
@@ -256,6 +264,7 @@ def arm_request(rctx, *, forced: bool = False, parent=None) -> bool:
     if _sample is None:
         return False
     rctx.trace_parent = parent if isinstance(parent, str) else None
+    rctx.trace_forced = bool(forced)
     if forced or hit(rctx.rid):
         rctx.trace_armed = True
         rctx.trace_span = new_span_id()
@@ -299,16 +308,18 @@ def span(name: str, **attrs) -> Iterator[None]:
         )
 
 
-def commit_children(rctx, root: str) -> None:
+def commit_children(rctx, root: str, *, spill: bool = False) -> None:
     """Commit the context's buffered child events under ``root`` —
     phase timers become ``phase.<name>`` spans, explicit spans keep
-    their own names."""
+    their own names. ``spill`` rides through to :func:`record_span`
+    (ISSUE 16: a forced/slowlog-worthy request's WHOLE tree goes to the
+    black box, not just its root)."""
     for name, w0, dt, attrs, is_phase in rctx.trace_events or ():
         if is_phase:
             record_span(
                 f"phase.{name}",
                 rid=rctx.rid, parent=root, start=w0,
-                duration_s=dt, attrs=attrs,
+                duration_s=dt, attrs=attrs, spill=spill,
             )
         else:
             # explicit trace.span() children: the name was validated at
@@ -316,7 +327,7 @@ def commit_children(rctx, root: str) -> None:
             record_span(
                 name,
                 rid=rctx.rid, parent=root, start=w0,
-                duration_s=dt, attrs=attrs,
+                duration_s=dt, attrs=attrs, spill=spill,
             )
 
 
@@ -333,6 +344,9 @@ def finish_request(
         return None
     if rctx.trace_armed:
         obs_counters.incr("trace_requests_sampled")
+    # black-box spill (ISSUE 16): the forced and slowlog-worthy trees
+    # are exactly the ones a crash post-mortem wants on disk
+    spill = slow or getattr(rctx, "trace_forced", False)
     root = rctx.trace_span or new_span_id()
     record_span(
         f"rpc.{rctx.method}",
@@ -342,17 +356,29 @@ def finish_request(
         start=rctx.started_at,
         duration_s=duration_s,
         attrs=attrs,
+        spill=spill,
     )
-    commit_children(rctx, root)
+    commit_children(rctx, root, spill=spill)
     return root
 
 
-def assemble(spans: list) -> dict:
+def assemble(spans: list, rid: Optional[str] = None) -> dict:
     """Client-side tree assembly over a merged span set: ``{span id ->
     [child span ids]}`` via parent edges AND link edges (a flush span
     adopts the requests it links as tree neighbors), plus the connected
     components — ONE component is the acceptance shape for a healthy
-    single-call trace."""
+    single-call trace.
+
+    With ``rid`` given (ISSUE 16 satellite, the PR-15 seam): a
+    multi-hop redirect chain — MOVED/ASK follow-ups, migration
+    re-drives — leaves one PARENTLESS ``client.hop`` root per hop, so
+    one logical call used to assemble as a forest. When more than one
+    root belongs to ``rid``'s own trace, a shared synthetic root
+    (``client.call``, marked ``attrs.synthesized``) adopts them, their
+    components merge, and the logical call renders as ONE tree. The
+    synthetic span is returned under ``"synthetic"`` (never recorded
+    into any ring — it exists only in assembled views, which is why it
+    is not part of the emitted-span registry)."""
     by_id = {s["span"]: s for s in spans}
     parent: dict = {}
     neighbors: dict = {s["span"]: set() for s in spans}
@@ -382,7 +408,39 @@ def assemble(spans: list) -> dict:
         seen |= comp
         components.append(sorted(comp))
     roots = [sid for sid in by_id if sid not in parent]
-    return {"roots": roots, "components": components, "parent": parent}
+    out = {"roots": roots, "components": components, "parent": parent}
+    if rid is not None:
+        orphans = [s for s in roots if by_id[s].get("rid") == rid]
+        if len(orphans) > 1:
+            synth_id = new_span_id()
+            starts = [float(by_id[s].get("start") or 0.0) for s in orphans]
+            ends = [
+                float(by_id[s].get("start") or 0.0)
+                + float(by_id[s].get("duration_s") or 0.0)
+                for s in orphans
+            ]
+            synthetic = {
+                "rid": rid,
+                "span": synth_id,
+                "parent": None,
+                "name": "client.call",
+                "start": min(starts),
+                "duration_s": max(ends) - min(starts),
+                "attrs": {"synthesized": True, "hops": len(orphans)},
+            }
+            adopted = set(orphans)
+            for s in orphans:
+                parent[s] = synth_id
+            merged, rest = {synth_id}, []
+            for comp in components:
+                if adopted & set(comp):
+                    merged.update(comp)
+                else:
+                    rest.append(comp)
+            out["components"] = rest + [sorted(merged)]
+            out["roots"] = [synth_id] + [s for s in roots if s not in adopted]
+            out["synthetic"] = synthetic
+    return out
 
 
 def reset_for_tests() -> None:

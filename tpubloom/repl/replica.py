@@ -553,35 +553,55 @@ class ReplicaApplier:
         # handler and the coalescer's flush used — so a cross-node
         # assembly shows where the record landed. Captured when the
         # record carries the forced flag (_log_op stamps it for sampled
-        # requests and traced flushes) or this node's own deterministic
-        # rid sample hits.
-        traced = obs_trace.enabled() and bool(rec.get("rid"))
+        # requests and traced flushes), this node's own deterministic
+        # rid sample hits, or — the ISSUE-16 satellite, same rule the
+        # server wrapper applies — the apply turns out SLOWLOG-WORTHY:
+        # an unsampled record whose apply would enter this replica's
+        # slowlog gets its span anyway, so the slow tail of the apply
+        # path traces like the slow tail of the serve path. Timing runs
+        # whenever the ring is armed, because the slow decision needs
+        # the duration first.
+        measured = obs_trace.enabled() and bool(rec.get("rid"))
+        forced = False
+        captured = False
         parent = None
-        if traced:
+        if measured:
             req_trace = (rec.get("req") or {}).get("trace")
             if isinstance(req_trace, dict):
-                traced = bool(req_trace.get("forced"))
+                forced = bool(req_trace.get("forced"))
+                captured = forced
                 p = req_trace.get("span")
                 parent = p if isinstance(p, str) else None
             else:
-                traced = obs_trace.hit(rec["rid"])
-        w0 = time.time() if traced else 0.0
-        t0 = time.perf_counter() if traced else 0.0
+                captured = obs_trace.hit(rec["rid"])
+        w0 = time.time() if measured else 0.0
+        t0 = time.perf_counter() if measured else 0.0
         applied = self.service.apply_record(rec)
-        if traced:
-            obs_trace.record_span(
-                "repl.apply",
-                rid=rec["rid"],
-                parent=parent,
-                start=w0,
-                duration_s=time.perf_counter() - t0,
-                attrs={
-                    "seq": int(rec["seq"]),
-                    "method": rec.get("method"),
-                    "filter": (rec.get("req") or {}).get("name"),
-                    "applied": bool(applied),
-                },
+        if measured:
+            duration_s = time.perf_counter() - t0
+            # the probe (a slowlog lock round trip) only matters when
+            # the record is not already captured
+            slow = not captured and self.service.slowlog.would_record(
+                duration_s
             )
+            if captured or slow:
+                obs_trace.record_span(
+                    "repl.apply",
+                    rid=rec["rid"],
+                    parent=parent,
+                    start=w0,
+                    duration_s=duration_s,
+                    attrs={
+                        "seq": int(rec["seq"]),
+                        "method": rec.get("method"),
+                        "filter": (rec.get("req") or {}).get("name"),
+                        "applied": bool(applied),
+                    },
+                    # forced and slowlog-worthy applies persist to the
+                    # black box (ISSUE 16) — a replica killed mid-apply
+                    # leaves the spans that explain what it was doing
+                    spill=forced or slow,
+                )
         if applied:
             self.records_applied += 1
             _counters.incr("repl_records_applied")

@@ -147,6 +147,7 @@ from tpubloom.obs import counters as obs_counters
 from tpubloom.config import FilterConfig, IDENTITY_FIELDS, identity_mismatch
 from tpubloom.filter import BloomFilter, CountingBloomFilter
 from tpubloom.obs import context as obs
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import flight as obs_flight
 from tpubloom.obs import trace as obs_trace
 from tpubloom.obs.slowlog import Slowlog, summarize_request
@@ -373,6 +374,14 @@ class BloomService:
         )
         obs_counters.set_gauge("ha_epoch", float(self.epoch))
         obs_counters.set_gauge("ha_role", 1.0 if read_only else 0.0)
+        # crash-forensics black box (ISSUE 16): stamp the node identity
+        # into the mapped ring (a no-op record when the box is
+        # disarmed) — every record written after this carries the
+        # current topology epoch, the fleet merge's primary sort key
+        obs_blackbox.set_node_meta(
+            epoch=self.epoch,
+            role="replica" if read_only else "primary",
+        )
         #: serializes role transitions (Promote / ReplicaOf)
         self._promote_lock = locks.named_lock("service.promote")
         #: where the creation manifest lives (the op log dir on nodes
@@ -743,6 +752,9 @@ class BloomService:
             except OSError:
                 log.exception("epoch persist failed (non-fatal)")
         obs_counters.set_gauge("ha_epoch", float(self.epoch))
+        # keep the black box's epoch stamp current (ISSUE 16) — the
+        # post-mortem timeline orders by epoch before wall clock
+        obs_blackbox.set_node_meta(epoch=self.epoch)
 
     def reappend_record(self, rec: dict) -> None:
         """Chained replica: re-append one upstream record VERBATIM to the
@@ -963,6 +975,9 @@ class BloomService:
             # the same lock, and it runs once, on the way down)
             obs_flight.note("oplog_failstop", method=method, error=repr(e))
             obs_flight.dump("fatal")
+            # msync the black box too (ISSUE 16): SIGKILL-safety needs
+            # nothing, but a fail-stop may precede a machine going down
+            obs_blackbox.sync()
             raise
         if mf is not None:
             mf.applied_seq = seq
@@ -1345,6 +1360,7 @@ class BloomService:
             )
             if status == "DEGRADED":
                 obs_flight.dump("degraded")
+                obs_blackbox.sync()
         resp = {
             "ok": True,
             "status": status,
@@ -2886,6 +2902,23 @@ def main(argv: Optional[list] = None) -> None:
         "dumps land here on SIGTERM, fatal write-path errors and Health "
         "DEGRADED flips",
     )
+    parser.add_argument(
+        "--blackbox-dir",
+        default=None,
+        metavar="DIR",
+        help="crash-forensics black box (ISSUE 16): map the SIGKILL-"
+        "surviving flight/trace rings under DIR/blackbox/ (default: "
+        "the op-log dir, else the checkpoint dir, else an explicit "
+        "--flight-dir — NOT $TPUBLOOM_FLIGHT_DIR, which many processes "
+        "share; no state dir at all leaves the box off). Read dead "
+        "nodes with `python -m tpubloom.obs.blackbox DIR`",
+    )
+    parser.add_argument(
+        "--no-blackbox",
+        action="store_true",
+        help="disable the crash-forensics black box even when a state "
+        "dir is available",
+    )
     args = parser.parse_args(argv)
     if args.min_replicas_to_write and not args.repl_log_dir:
         parser.error("--min-replicas-to-write requires --repl-log-dir")
@@ -2960,6 +2993,23 @@ def main(argv: Optional[list] = None) -> None:
     )
     if flight_dir:
         obs_flight.configure(dump_dir=flight_dir)
+    # crash-forensics black box (ISSUE 16): the mapped rings live in a
+    # NODE-PRIVATE state dir (ring file names are fixed so a restart
+    # reattaches to its own pre-crash history — a shared dir like
+    # $TPUBLOOM_FLIGHT_DIR would collide across processes, so it is
+    # deliberately not a fallback here)
+    blackbox_dir = (
+        None
+        if args.no_blackbox
+        else (
+            args.blackbox_dir
+            or args.repl_log_dir
+            or ckpt_dir
+            or args.flight_dir
+        )
+    )
+    if blackbox_dir:
+        obs_blackbox.configure(blackbox_dir, node={"addr": announce})
     service = BloomService(
         sink_factory=sink_factory,
         slowlog_capacity=args.slowlog_capacity,
@@ -3016,6 +3066,16 @@ def main(argv: Optional[list] = None) -> None:
         )
     server, bound = build_server(service, f"0.0.0.0:{args.port}")
     server.start()
+    # power-on record (ISSUE 16): every state dir's black box carries
+    # at least this — the anchor a post-mortem needs to know WHICH
+    # process (role, epoch, address) wrote the final events before a
+    # SIGKILL that ran no handler
+    obs_flight.note(
+        "boot",
+        role="replica" if args.replica_of else "primary",
+        epoch=int(service.epoch),
+        addr=announce,
+    )
     log.info("tpubloom server listening on :%d (checkpoints: %s)", bound, ckpt_dir)
     metrics_server = None
     if args.metrics_port is not None:
@@ -3042,6 +3102,10 @@ def main(argv: Optional[list] = None) -> None:
     # when the process stops being scrapeable
     obs_flight.note("drain", grace_s=float(args.drain_grace))
     obs_flight.dump("sigterm")
+    # black box msync (ISSUE 16): the drain note above already landed
+    # in the mapped ring lock-free; flushing here covers the machine-
+    # crash-during-drain case
+    obs_blackbox.sync()
     service.begin_drain()
     # Notice window BEFORE the port closes: grpc's stop() rejects new RPCs
     # at the transport, so without this pause clients would only ever see

@@ -32,6 +32,17 @@ What the numbers mean:
   the handlers run on, so per-key kernel cost (~3us/key measured)
   bounds any single-dispatcher aggregate.
 
+A **streaming phase** (ISSUE 18) pits the persistent bidi ingest plane
+against unary on the same server: N ``insert_stream`` sessions pumping
+``BATCH``-key frames under credit flow control vs N unary connections
+sending the same frames. The gate is ``streaming_vs_unary >= 1.0``
+(re-measured once with a doubled window, like the coalesce gate) — a
+long-lived stream pays no per-request channel bookkeeping, so falling
+BELOW unary means the ack pump or credit path regressed. Anti-gaming:
+every frame the rate counts must show up in the server's
+``stream_frames_total`` / ``stream_acks_total`` deltas — the clock only
+stops after ``drain()``, so unacked frames can't inflate the number.
+
 A second phase (skippable via ``quorum=False``) runs a primary+replica
 pair with ``--min-replicas-to-write 1``: the commit barrier must run
 once per FLUSH, not once per write — the run asserts barrier
@@ -64,6 +75,9 @@ BATCH = 64
 #: acceptance gate: N coalesced connections must beat ONE connection's
 #: rate by this factor (the lock-serialized path measures ~1.3x here).
 GATE = 2.0
+#: streaming gate (ISSUE 18): bidi stream frames/sec vs unary frames/sec
+#: on the same server — the persistent plane must at least match unary.
+STREAM_GATE = 1.0
 
 _CHILD = """\
 import sys
@@ -155,16 +169,86 @@ def _hammer(
     return rate
 
 
-def _warm_buckets(client, name: str) -> None:
+def _stream_hammer(
+    addr: str, name: str, threads: int, duration_s: float
+) -> tuple:
+    """(frames/sec, frames sent) over `threads` persistent bidi
+    InsertStream sessions, each pumping BATCH-key frames as fast as the
+    server's credit window admits them. The clock stops only after every
+    session DRAINED — a frame counts when its ack arrived, the same
+    contract the unary hammer's response-wait gives."""
+    from tpubloom.server.client import BloomClient
+
+    clients = [BloomClient(addr) for _ in range(threads)]
+    for c in clients:  # negotiate + warm the channel outside the window
+        c.insert_batch(name, np.arange(BATCH, dtype=np.uint64))
+    stop = time.monotonic() + duration_s
+    counts = [0] * threads
+
+    def worker(t):
+        c = clients[t]
+        base = np.arange(BATCH, dtype=np.uint64) + (t + 1) * (1 << 44)
+        sent = 0
+        with c.insert_stream(name) as s:
+            while time.monotonic() < stop:
+                s.send(base + sent * BATCH)
+                sent += 1
+            s.drain(timeout=120)
+        counts[t] = sent
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    for c in clients:
+        c.close()
+    return sum(counts) / elapsed, sum(counts)
+
+
+def _stream_counters(client) -> tuple:
+    counters = client.stats()["counters"]
+    return (
+        counters.get("stream_frames_total", 0),
+        counters.get("stream_acks_total", 0),
+    )
+
+
+def _measure_streaming(addr: str, name: str, duration_s: float,
+                       stats_client) -> dict:
+    unary = _hammer(addr, name, CONNECTIONS, duration_s)
+    f0, a0 = _stream_counters(stats_client)
+    stream_rate, frames_sent = _stream_hammer(
+        addr, name, CONNECTIONS, duration_s
+    )
+    f1, a1 = _stream_counters(stats_client)
+    unary_frames = unary / BATCH
+    return {
+        "unary_frames_per_sec": round(unary_frames),
+        "stream_frames_per_sec": round(stream_rate),
+        "streaming_vs_unary": round(stream_rate / unary_frames, 3),
+        "stream_frames_sent": frames_sent,
+        "stream_frames_recv": f1 - f0,
+        "stream_acks_recv": a1 - a0,
+    }
+
+
+def _warm_buckets(client, name: str, up_to: int = None) -> None:
     """Compile every jit bucket a coalesced flush can produce (merged
-    sizes pad to powers of two in [BATCH, CONNECTIONS*BATCH]) — without
+    sizes pad to powers of two in [BATCH, up_to]) — without
     this the aggregate window eats one ~0.4s XLA compile per new shape
     and the measurement is compile time, not ingest time (the same
-    lesson cluster_smoke's warm-up comment pins)."""
+    lesson cluster_smoke's warm-up comment pins). Unary ping-pong with
+    CONNECTIONS in flight can merge at most CONNECTIONS*BATCH keys (the
+    default); the streaming phase pipelines a 32-frame window per
+    session, so its flushes grow to the coalescer's max-keys cap and it
+    warms that far."""
     from tpubloom.server import protocol
 
     size = BATCH
-    while size <= CONNECTIONS * BATCH:
+    while size <= (up_to or CONNECTIONS * BATCH):
         try:
             client.insert_batch(
                 name, np.arange(size, dtype=np.uint64) + (1 << 50) + size
@@ -233,7 +317,39 @@ def run_load(
             # window can flip the comparison with no code defect
             out["remeasured"] = True
             out.update(_measure(addr, "ingest", duration_s * 2, boot))
+        # streaming plane (ISSUE 18): same server, same frames — the
+        # persistent stream must at least match unary frame throughput.
+        # Pipelined windows park enough to hit the coalescer's max-keys
+        # cap, so the jit buckets up to it must be warm first.
+        max_keys = BATCH
+        for flag, value in zip(coalesce_args, coalesce_args[1:]):
+            if flag == "--coalesce-max-keys":
+                max_keys = int(value)
+        _warm_buckets(boot, "ingest", up_to=max_keys)
+        out.update(_measure_streaming(addr, "ingest", duration_s, boot))
+        if out["streaming_vs_unary"] < STREAM_GATE:
+            out["stream_remeasured"] = True
+            out.update(
+                _measure_streaming(addr, "ingest", duration_s * 2, boot)
+            )
         boot.close()
+        assert out["streaming_vs_unary"] >= STREAM_GATE, (
+            f"bidi streaming moved {out['stream_frames_per_sec']} "
+            f"frames/s vs {out['unary_frames_per_sec']} unary — a "
+            f"persistent stream below unary means the ack pump or "
+            f"credit path regressed (gate {STREAM_GATE}x)"
+        )
+        # anti-gaming: every frame the rate counted must have been
+        # RECEIVED and ACKED by the server during the window — a rate
+        # computed off unsent/unacked frames cannot clear this
+        assert out["stream_frames_recv"] >= out["stream_frames_sent"], (
+            f"server received {out['stream_frames_recv']} stream frames "
+            f"but the rate counted {out['stream_frames_sent']}"
+        )
+        assert out["stream_acks_recv"] >= out["stream_frames_sent"], (
+            f"server acked {out['stream_acks_recv']} stream frames "
+            f"but the rate counted {out['stream_frames_sent']}"
+        )
         assert out["scaling_vs_single"] >= GATE, (
             f"coalesced aggregate ({out['aggregate_keys_per_sec']} keys/s "
             f"over {CONNECTIONS} connections) is only "

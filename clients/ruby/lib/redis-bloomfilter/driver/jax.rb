@@ -269,7 +269,76 @@ class Redis
           rpc("ReplicaOf", req, no_retry: true)
         end
 
+        # -- streaming ingest plane (ISSUE 18) -------------------------
+        #
+        # One persistent bidi RPC carries many seq-stamped key frames;
+        # the server acks each frame with the full unary-shaped verdict
+        # (acks echo the frame's seq and are NOT necessarily in frame
+        # order — see BIDI_STREAM_METHODS in tpubloom/server/protocol.py).
+        # This driver ignores the server's advisory credit grants: an
+        # over-sending stream is PARKED by the server's bounded ingest
+        # backpressure (gRPC/TCP flow control pushes back), never shed,
+        # so correctness holds either way. Each frame keeps its own rid;
+        # replaying a broken stream's unacked frames under those rids is
+        # answered from the server's dedup cache (exactly-once).
+
+        # Ship each key batch as one InsertStream frame; returns the
+        # per-batch responses in batch order (raises ServiceError on the
+        # first error verdict).
+        def insert_stream(batches, min_replicas: nil, return_presence: false)
+          stream_frames("InsertStream", batches) do |payload|
+            payload["return_presence"] = true if return_presence
+            durability(payload, min_replicas)
+          end
+        end
+
+        # Ship each key batch as one QueryStream frame; returns one
+        # boolean membership array per batch, in batch order.
+        def query_stream(batches)
+          stream_frames("QueryStream", batches).map do |resp|
+            unpack_bits(resp["hits"], resp["n"])
+          end
+        end
+
         private
+
+        def stream_frames(method, batches)
+          seq = 0
+          frames = batches.map do |keys|
+            seq += 1
+            payload = encode_keys(
+              { "seq" => seq, "rid" => SecureRandom.hex(8), "name" => @name },
+              keys
+            )
+            payload["epoch"] = @epoch if @epoch && method == "InsertStream"
+            payload = yield(payload) || payload if block_given?
+            payload
+          end
+          acks = {}
+          responses = @stub.bidi_streamer(
+            "/#{SERVICE}/#{method}",
+            frames.map(&:to_msgpack).each,
+            IDENTITY,
+            IDENTITY
+          )
+          responses.each do |raw|
+            frame = MessagePack.unpack(raw)
+            next unless frame["kind"] == "ack"
+            resp = frame["resp"] || {}
+            @last_write_seq = resp["repl_seq"] if resp["repl_seq"]
+            acks[frame["seq"]] = resp
+          end
+          (1..seq).map do |s|
+            resp = acks[s] || {}
+            unless resp["ok"]
+              err = resp["error"] || {}
+              raise ServiceError.new(
+                err["code"] || "UNKNOWN", err["message"], err["details"]
+              )
+            end
+            resp
+          end
+        end
 
         def connect(address)
           @address = address

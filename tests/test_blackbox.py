@@ -186,6 +186,102 @@ def test_disabled_by_default_and_write_through(tmp_path):
     assert node["skipped"] == 0
 
 
+# -- ring snapshots on DEGRADED (ISSUE 18 satellite) -------------------------
+
+
+def test_snapshot_rings_copies_and_prunes(tmp_path):
+    """The live rings overwrite oldest-first; ``snapshot_rings`` must
+    freeze a decodable copy next to them and keep only the newest
+    ``max_snapshots`` snapshot dirs."""
+    assert blackbox.snapshot_rings("noop") is None  # disarmed: declines
+    assert blackbox.configure(str(tmp_path), node={"addr": "n1:1"})
+    flight.configure(capacity=16)
+    flight.note("boot", role="primary")
+    trace.configure(sample=1.0)
+    trace.record_span(
+        "repl.apply", rid="r-snap", start=1.0, duration_s=0.1, spill=True
+    )
+    snaps = []
+    for i in range(4):
+        # distinct reasons keep the dir names unique even when two
+        # snapshots land inside the same millisecond
+        snap = blackbox.snapshot_rings(f"degraded-{i}", max_snapshots=2)
+        assert snap is not None
+        snaps.append(snap)
+    bb_dir = os.path.join(str(tmp_path), blackbox.SUBDIR)
+    kept = sorted(
+        d for d in os.listdir(bb_dir)
+        if d.startswith(blackbox.SNAP_PREFIX)
+    )
+    assert kept == sorted(os.path.basename(s) for s in snaps[-2:]), (
+        "only the newest 2 snapshots survive pruning"
+    )
+    # a snapshot is a self-contained post-mortem: both rings decode with
+    # the records that were live at freeze time
+    frozen = blackbox.read_ring(
+        os.path.join(snaps[-1], blackbox.TRACE_RING)
+    )
+    assert [r["rid"] for r in frozen["records"]] == ["r-snap"]
+    events = blackbox.read_ring(
+        os.path.join(snaps[-1], blackbox.FLIGHT_RING)
+    )
+    assert "boot" in [r.get("kind") for r in events["records"]]
+    # reason tags are path-sanitized, never path components
+    weird = blackbox.snapshot_rings("../esc ape", max_snapshots=8)
+    assert weird is not None
+    assert os.path.dirname(os.path.abspath(weird)) == os.path.abspath(bb_dir)
+
+
+def test_health_degraded_flip_snapshots_rings(tmp_path):
+    """SERVING -> DEGRADED freezes the rings once (the flip, not every
+    DEGRADED probe): the history leading up to the incident survives
+    the live rings' wraparound."""
+    from tpubloom import checkpoint as ckpt
+    from tpubloom.server.protocol import BloomServiceError
+    from tpubloom.server.service import BloomService, build_server
+
+    flight.configure(dump_dir=str(tmp_path / "dumps"))
+    assert blackbox.configure(str(tmp_path / "state"))
+    svc = BloomService(
+        sink_factory=lambda c: ckpt.FileSink(str(tmp_path / "ckpt"))
+    )
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    c = BloomClient(f"127.0.0.1:{port}")
+    bb_dir = os.path.join(str(tmp_path / "state"), blackbox.SUBDIR)
+
+    def _snaps():
+        return sorted(
+            d for d in os.listdir(bb_dir)
+            if d.startswith(blackbox.SNAP_PREFIX)
+        )
+
+    try:
+        c.wait_ready()
+        c.create_filter("t", capacity=10_000, error_rate=0.01)
+        assert _snaps() == []
+        faults.arm("ckpt.write", "always")
+        c.insert_batch("t", [b"x"])
+        try:
+            c.checkpoint("t", wait=True)
+        except BloomServiceError:
+            pass
+        assert c.health()["status"] == "DEGRADED"
+        snaps = _snaps()
+        assert len(snaps) == 1, "the flip must freeze the rings once"
+        assert "degraded" in snaps[0]
+        for fname in (blackbox.FLIGHT_RING, blackbox.TRACE_RING):
+            frozen = blackbox.read_ring(os.path.join(bb_dir, snaps[0], fname))
+            assert frozen["geometry"]["nslots"] > 0
+        # a second DEGRADED answer is not a flip: no second snapshot
+        c.health()
+        assert _snaps() == snaps
+    finally:
+        faults.reset()
+        c.close()
+        srv.stop(grace=None)
+
+
 def test_cli_merges_fleet_timeline_with_oplog_correlation(tmp_path, capsys):
     # node A: epoch-1 primary with an op log that committed rid r-1
     dir_a = tmp_path / "node-a"

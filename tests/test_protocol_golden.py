@@ -120,6 +120,30 @@ GOLDEN_STREAM_DICTS = {
     "Monitor": {"name": "golden"},
 }
 
+#: bidi streaming ingest frames (ISSUE 18): one seq-stamped data frame
+#: per method — the exact bytes the Ruby driver's stream_frames (and the
+#: Python StreamSession) produce. The server's side of the contract
+#: (hello + seq-echoing ack frames wrapping the full unary-shaped resp)
+#: is asserted live in test_golden_bidi_replay.
+GOLDEN_BIDI = {
+    "InsertStream": (
+        "InsertStream",
+        "84a373657101a3726964b1676f6c64656e2d73747265616d2d726964a46e616d65"
+        "a6676f6c64656ea46b65797392c404736b2d31a4736b2d32",
+    ),
+    "QueryStream": (
+        "QueryStream",
+        "84a373657101a3726964b1676f6c64656e2d73747265616d2d726964a46e616d65"
+        "a6676f6c64656ea46b65797392c404736b2d31a6616273656e74",
+    ),
+}
+GOLDEN_BIDI_DICTS = {
+    "InsertStream": {"seq": 1, "rid": "golden-stream-rid",
+                     "name": "golden", "keys": [b"sk-1", "sk-2"]},
+    "QueryStream": {"seq": 1, "rid": "golden-stream-rid",
+                    "name": "golden", "keys": [b"sk-1", "absent"]},
+}
+
 #: the dict each fixture encodes (the pin below keeps python<->ruby
 #: encodings provably in sync; regenerate hex from these on change)
 GOLDEN_DICTS = {
@@ -181,6 +205,11 @@ def test_every_method_has_a_golden():
         "golden fixtures must cover every streaming method; missing: "
         f"{set(protocol.STREAM_METHODS) - stream_covered}"
     )
+    bidi_covered = {m for m, _ in GOLDEN_BIDI.values()}
+    assert bidi_covered == set(protocol.BIDI_STREAM_METHODS), (
+        "golden fixtures must cover every bidi stream method; missing: "
+        f"{set(protocol.BIDI_STREAM_METHODS) - bidi_covered}"
+    )
 
 
 def test_golden_bytes_match_ruby_encoding():
@@ -198,6 +227,10 @@ def test_golden_bytes_match_ruby_encoding():
         assert msgpack.packb(
             GOLDEN_STREAM_DICTS[name], use_bin_type=True
         ).hex() == hexbytes, f"stream fixture {name} drifted"
+    for name, (_, hexbytes) in GOLDEN_BIDI.items():
+        assert msgpack.packb(
+            GOLDEN_BIDI_DICTS[name], use_bin_type=True
+        ).hex() == hexbytes, f"bidi fixture {name} drifted"
 
 
 @pytest.fixture()
@@ -413,6 +446,47 @@ def test_golden_stream_replay(tmp_path):
         channel.close()
         srv.stop(grace=None)
         service.oplog.close()
+
+
+def test_golden_bidi_replay(raw_server):
+    """InsertStream/QueryStream golden frames replayed RAW (ISSUE 18):
+    the server must answer hello (with a credit grant) first, then one
+    ack per data frame echoing its seq and wrapping the full
+    unary-shaped response — the exact frames the Ruby driver's
+    stream_frames parses."""
+    ch = raw_server
+    assert _call(ch, *GOLDEN["CreateFilter"])["ok"]
+
+    def bidi(method, hexbytes):
+        call = ch.stream_stream(
+            protocol.method_path(method),
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(iter([bytes.fromhex(hexbytes)]), timeout=30)
+        return [msgpack.unpackb(raw, raw=False) for raw in call]
+
+    frames = bidi(*GOLDEN_BIDI["InsertStream"])
+    assert frames[0]["kind"] == "hello"
+    assert isinstance(frames[0]["credit"], int) and frames[0]["credit"] >= 1
+    acks = [f for f in frames[1:] if f["kind"] == "ack"]
+    assert len(acks) == 1
+    assert acks[0]["seq"] == GOLDEN_BIDI_DICTS["InsertStream"]["seq"]
+    assert isinstance(acks[0]["credit"], int) and acks[0]["credit"] >= 1
+    resp = acks[0]["resp"]
+    assert resp["ok"] and resp["n"] == 2
+
+    frames = bidi(*GOLDEN_BIDI["QueryStream"])
+    assert frames[0]["kind"] == "hello"
+    (ack,) = [f for f in frames[1:] if f["kind"] == "ack"]
+    assert ack["seq"] == 1
+    resp = ack["resp"]
+    assert resp["ok"] and resp["n"] == 2 and isinstance(resp["hits"], bytes)
+    bits = np.unpackbits(
+        np.frombuffer(resp["hits"], np.uint8), bitorder="big"
+    )[:2]
+    assert bits[0] and not bits[1], (
+        "streamed insert must be queryable via the stream; 'absent' must miss"
+    )
 
 
 def test_golden_ack_frame_replay(raw_service_server):

@@ -48,6 +48,19 @@ def _disarm_all():
     faults.reset()
 
 
+@pytest.fixture(autouse=True)
+def _blackbox_reset():
+    """ISSUE 18 satellite: replicas now arm the process-global black box
+    from their state dir — drop the mapping between tests so one test's
+    replica ring (in a soon-deleted tmp_path) never absorbs the next
+    test's records."""
+    from tpubloom.obs import blackbox
+
+    blackbox.reset_for_tests()
+    yield
+    blackbox.reset_for_tests()
+
+
 def _rand_keys(n, rng):
     return [rng.bytes(16) for _ in range(n)]
 
@@ -865,6 +878,55 @@ def test_client_read_preference_routes_to_replica(tmp_path):
         client.close()
         psrv.stop(grace=None)
         oplog.close()
+
+
+def test_replica_blackbox_arms_from_state_store(tmp_path):
+    """ISSUE 18 satellite: a replica given any durable state dir arms
+    the PR-16 black box there — post-mortems of killed replicas stop
+    depending on the server entrypoint having plumbed a log dir."""
+    from tpubloom.obs import blackbox as bb
+    from tpubloom.repl.replica import ReplicaStateStore
+
+    oplog = OpLog(str(tmp_path / "log"))
+    psrv, psvc, pport = _server(tmp_path, oplog=oplog, trace_sample=1.0)
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    rsvc.listen_address = f"127.0.0.1:{rport}"
+    state_dir = str(tmp_path / "replica-state")
+    applier = ReplicaApplier(
+        rsvc,
+        f"127.0.0.1:{pport}",
+        reconnect_base=0.05,
+        state_store=ReplicaStateStore(state_dir),
+        listen_address=rsvc.listen_address,
+    ).start()
+    pc = BloomClient(f"127.0.0.1:{pport}", trace_sample=1.0)
+    try:
+        assert bb.enabled(), "a state store alone must arm the black box"
+        pc.wait_ready()
+        pc.create_filter("bbx", capacity=10_000, error_rate=0.01)
+        # forced traces spill repl.apply spans into the replica's ring
+        pc.insert_batch("bbx", [b"bb-%03d" % i for i in range(64)])
+        assert applier.wait_for_seq(oplog.last_seq, 60), applier.status()
+    finally:
+        applier.stop()
+        pc.close()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+    # the ring is readable post-mortem (no live process needed) and
+    # identifies WHO wrote it — role, announced address, upstream
+    node = bb.read_node(state_dir)
+    assert node is not None, "replica state dir must hold a black box"
+    assert node["meta"].get("role") == "replica"
+    assert node["meta"].get("addr") == f"127.0.0.1:{rport}"
+    assert node["meta"].get("primary") == f"127.0.0.1:{pport}"
+    applies = [s for s in node["spans"] if s.get("name") == "repl.apply"]
+    assert applies, "forced applies must spill into the replica's ring"
+    assert any(
+        s.get("attrs", {}).get("filter") == "bbx" for s in applies
+    )
 
 
 # -- MONITOR parity ----------------------------------------------------------

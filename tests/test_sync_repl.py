@@ -56,6 +56,18 @@ def _disarm_all():
     faults.reset()
 
 
+@pytest.fixture(autouse=True)
+def _blackbox_reset():
+    """ISSUE 18 satellite: replicas arm the process-global black box
+    from their state dir — unmap between tests so a ring in one test's
+    tmp_path never absorbs the next test's records."""
+    from tpubloom.obs import blackbox
+
+    blackbox.reset_for_tests()
+    yield
+    blackbox.reset_for_tests()
+
+
 def _wait(pred, timeout=30.0, poll=0.02, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -614,6 +626,63 @@ def test_barrier_unblocks_when_last_replica_disconnects(tmp_path):
         rsrv.stop(grace=None)
         psrv.stop(grace=None)
         poplog.close()
+
+
+def test_sync_replica_blackbox_covers_quorum_applies(tmp_path):
+    """ISSUE 18 satellite: an in-process sync replica given a state
+    store arms the PR-16 black box there, so the post-mortem of a
+    quorum write covers the REPLICA side too — the ring names the node
+    (role/addr/upstream) and carries the forced ``repl.apply`` spans
+    behind the ack the barrier waited on."""
+    from tpubloom.obs import blackbox as bb
+    from tpubloom.repl.replica import ReplicaStateStore
+
+    psvc, psrv, pport, poplog = _primary(
+        tmp_path, min_replicas_to_write=1, trace_sample=1.0
+    )
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    rsvc.listen_address = f"127.0.0.1:{rport}"
+    state_dir = str(tmp_path / "replica-state")
+    applier = ReplicaApplier(
+        rsvc,
+        f"127.0.0.1:{pport}",
+        reconnect_base=0.05,
+        state_store=ReplicaStateStore(state_dir),
+        listen_address=rsvc.listen_address,
+    ).start()
+    c = BloomClient(f"127.0.0.1:{pport}", trace_sample=1.0)
+    try:
+        assert bb.enabled(), "a state store alone must arm the black box"
+        c.wait_ready()
+        c.create_filter("cnt", capacity=10_000, error_rate=0.01,
+                        counting=True)
+        _warm(c, applier, poplog)
+        # the barrier releases only after THIS replica acked the apply
+        resp = c._call_once(
+            "InsertBatch",
+            {"name": "cnt", "keys": [b"quorum-bb"], "min_replicas": 1,
+             "min_replicas_timeout_ms": 30_000,
+             "trace": {"forced": True}},
+        )
+        assert resp["acked_replicas"] >= 1
+        assert applier.wait_for_seq(poplog.last_seq, 60), applier.status()
+    finally:
+        c.close()
+        applier.stop()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        poplog.close()
+    node = bb.read_node(state_dir)
+    assert node is not None, "replica state dir must hold a black box"
+    assert node["meta"].get("role") == "replica"
+    assert node["meta"].get("addr") == f"127.0.0.1:{rport}"
+    assert node["meta"].get("primary") == f"127.0.0.1:{pport}"
+    applies = [s for s in node["spans"] if s.get("name") == "repl.apply"]
+    assert any(
+        s.get("attrs", {}).get("filter") == "cnt" for s in applies
+    ), "the quorum-acked apply must have spilled into the replica ring"
 
 
 # -- the acceptance chaos story ----------------------------------------------

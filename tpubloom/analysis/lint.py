@@ -1058,7 +1058,8 @@ def _service_handlers(path: str) -> tuple:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for t in node.targets:
                 if isinstance(t, ast.Name) and t.id in (
-                    "_STREAM_BEHAVIORS", "_CLIENT_STREAM_BEHAVIORS"
+                    "_STREAM_BEHAVIORS", "_CLIENT_STREAM_BEHAVIORS",
+                    "_BIDI_STREAM_BEHAVIORS",
                 ):
                     behaviors |= {
                         k.value
@@ -1076,7 +1077,11 @@ def check_protocol_coverage(repo_root: str) -> list:
     if not os.path.isfile(proto_path) or not os.path.isfile(service_path):
         return []  # partial fixture tree: nothing to cross-reference
     decls = _parse_string_collection(
-        proto_path, ("METHODS", "STREAM_METHODS", "CLIENT_STREAM_METHODS")
+        proto_path,
+        (
+            "METHODS", "STREAM_METHODS", "CLIENT_STREAM_METHODS",
+            "BIDI_STREAM_METHODS",
+        ),
     )
     client_path = os.path.join(repo_root, "tpubloom", "server", "client.py")
     golden_path = os.path.join(repo_root, "tests", "test_protocol_golden.py")
@@ -1107,6 +1112,15 @@ def check_protocol_coverage(repo_root: str) -> list:
             miss(m, "service behavior registration (_*_BEHAVIORS map)")
         if m not in golden_lits:
             miss(m, "golden wire test (literal in test_protocol_golden.py)")
+    # bidi streams (ISSUE 18) additionally require a Python client call
+    # site — unlike ReplStream/ReplAck they are a user-facing surface
+    for m in decls.get("BIDI_STREAM_METHODS", ()):
+        if m not in behaviors:
+            miss(m, "service behavior registration (_*_BEHAVIORS map)")
+        if m not in client_lits:
+            miss(m, "client call site (literal in client.py)")
+        if m not in golden_lits:
+            miss(m, "golden wire test (literal in test_protocol_golden.py)")
     return findings
 
 
@@ -1128,8 +1142,11 @@ def check_ruby_parity(repo_root: str) -> list:
     proto_path = os.path.join(repo_root, "tpubloom", "server", "protocol.py")
     if not os.path.isfile(proto_path):
         return []  # partial fixture tree
-    decls = _parse_string_collection(proto_path, ("METHODS",))
+    decls = _parse_string_collection(
+        proto_path, ("METHODS", "BIDI_STREAM_METHODS")
+    )
     methods = list(decls.get("METHODS", ()))
+    bidi = list(decls.get("BIDI_STREAM_METHODS", ()))
     driver_dir = os.path.join(repo_root, RUBY_DRIVER_DIR)
     findings: list = []
     if not methods or not os.path.isdir(driver_dir):
@@ -1173,6 +1190,16 @@ def check_ruby_parity(repo_root: str) -> list:
             f"Ruby METHODS registry lists {extra!r}, which is not a "
             f"protocol method — stale registry entry",
         ))
+    # bidi stream methods (ISSUE 18): a call-site literal is required
+    # (the registry equality stays METHODS-only — streams dial
+    # bidi_streamer paths, not the unary rpc_once table)
+    for m in bidi:
+        if f'"{m}"' not in all_src and f"'{m}'" not in all_src:
+            findings.append(Finding(
+                "ruby-parity", base_path, 0,
+                f"bidi stream method {m!r} has no call site in any Ruby "
+                f"driver (clients/ruby)",
+            ))
     return findings
 
 

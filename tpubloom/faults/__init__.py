@@ -114,6 +114,8 @@ KNOWN_POINTS = {
     "cluster.migrate_apply",
     "ingest.coalesce",
     "ingest.flush",
+    "stream.recv",
+    "stream.ack",
     "storage.evict",
     "storage.hydrate",
     "shard.insert",

@@ -66,6 +66,7 @@ import json
 import logging
 import mmap
 import os
+import shutil
 import sys
 import time
 from typing import Optional
@@ -291,6 +292,53 @@ def sync() -> None:
     for ring in (_flight_ring, _trace_ring):
         if ring is not None:
             ring.sync()
+
+
+#: snapshot directory prefix under ``blackbox/`` — the post-mortem CLI
+#: and pruning both key on it
+SNAP_PREFIX = "snap-"
+
+
+def snapshot_rings(reason: str, max_snapshots: int = 8) -> Optional[str]:
+    """Freeze both rings into ``blackbox/snap-<ts>-<reason>/`` (ISSUE 18
+    satellite). The rings are oldest-first OVERWRITE buffers — by the
+    time someone reads a DEGRADED incident, minutes of healthy traffic
+    may have lapped the records that explain it. Health's
+    SERVING→DEGRADED flip calls this so the lead-up survives. Bounded:
+    the oldest snapshots beyond ``max_snapshots`` are pruned (a
+    flapping health check must not fill the disk). Best-effort like
+    every writer here — returns the snapshot dir, or None (disarmed or
+    IO error), and never raises."""
+    directory = _dir
+    if directory is None:
+        return None
+    sync()  # the copies must include everything written so far
+    tag = "".join(
+        c if c.isalnum() or c in "-_" else "-" for c in reason
+    ) or "unknown"
+    snap = os.path.join(
+        directory, f"{SNAP_PREFIX}{int(time.time() * 1000):013d}-{tag}"
+    )
+    try:
+        os.makedirs(snap, exist_ok=True)
+        for fname in (FLIGHT_RING, TRACE_RING):
+            src = os.path.join(directory, fname)
+            if os.path.exists(src):
+                shutil.copyfile(src, os.path.join(snap, fname))
+    except OSError:
+        log.exception("black box: ring snapshot failed in %s", directory)
+        return None
+    try:
+        snaps = sorted(
+            d for d in os.listdir(directory)
+            if d.startswith(SNAP_PREFIX)
+            and os.path.isdir(os.path.join(directory, d))
+        )
+        for stale in snaps[:-max_snapshots] if max_snapshots > 0 else snaps:
+            shutil.rmtree(os.path.join(directory, stale), ignore_errors=True)
+    except OSError:
+        pass  # pruning is advisory; the snapshot itself landed
+    return snap
 
 
 def reset_for_tests() -> None:

@@ -123,6 +123,10 @@ COUNTERS = (
     "storage_hydrations_shed",
     "storage_hydrations_total",
     "storage_warm_demotions",
+    "stream_acks_total",
+    "stream_credit_throttles",
+    "stream_frame_dedup_hits",
+    "stream_frames_total",
     "trace_requests_sampled",
     "trace_spans_recorded",
 )
@@ -154,6 +158,7 @@ GAUGES = (
     "storage_resident_filters",
     "storage_warm_bytes",
     "storage_warm_filters",
+    "stream_connected_current",
     "trace_buffer_spans",
     "wait_blocked_current",
 )
@@ -200,6 +205,10 @@ PHASE_DYNAMIC_PREFIXES = (
 #:   ``links`` name every parked request's root span, so N-to-1
 #:   batching stays explainable; kernel phases + the barrier are its
 #:   children)
+#: * ``ingest.stream_recv`` — one streamed data frame's receive-and-
+#:   park window on the bidi ingest plane (ISSUE 18): decode through
+#:   park (or inline direct-path completion), under the FRAME's rid so
+#:   the flush's links still resolve; attrs carry method/seq/parked
 #: * ``barrier.wait``    — the synchronous-replication commit barrier
 #:   (direct path: child of the request; coalesced: child of the flush)
 #: * ``cluster.forward`` — a migration dual-write forward to the slot's
@@ -225,6 +234,7 @@ SPANS = (
     "client.hop",
     "ingest.park",
     "ingest.flush",
+    "ingest.stream_recv",
     "barrier.wait",
     "cluster.forward",
     "repl.apply",

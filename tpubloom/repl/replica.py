@@ -43,6 +43,7 @@ import grpc
 import msgpack
 
 from tpubloom import faults
+from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import counters as _counters
 from tpubloom.obs import trace as obs_trace
 from tpubloom.server import protocol
@@ -285,6 +286,35 @@ class ReplicaApplier:
         #: from here on the local op log (if any) is fed by reappend —
         #: handler-side appends would mint conflicting seqs
         service._stream_fed = True
+        # crash-forensics black box (ISSUE 18 satellite): replicas used
+        # to arm the PR-16 rings only when the server ENTRYPOINT had a
+        # log/ckpt dir to pass along — an in-process chaos replica
+        # (test_repl / test_sync_repl) carries a state store but never
+        # runs that entrypoint, so its post-mortem rings did not exist.
+        # Arm from whatever durable dir this replica already owns; the
+        # box is process-global, so never steal one another configure()
+        # claimed (the replica's records still land in THAT ring), and
+        # only stamp node identity on the ring we armed ourselves —
+        # overwriting a co-hosted primary's meta would misattribute its
+        # post-mortem timeline.
+        state_dir = None
+        if state_store is not None:
+            state_dir = state_store.directory
+        elif service.oplog is not None:
+            state_dir = getattr(service.oplog, "directory", None)
+        if state_dir is not None and not obs_blackbox.enabled():
+            obs_blackbox.configure(
+                state_dir,
+                node={
+                    k: v
+                    for k, v in {
+                        "role": "replica",
+                        "addr": listen_address,
+                        "primary": primary_address,
+                    }.items()
+                    if v is not None
+                },
+            )
 
     def start(self) -> "ReplicaApplier":
         self._thread.start()

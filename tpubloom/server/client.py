@@ -76,6 +76,7 @@ Retries of one logical call share the rid.
 
 from __future__ import annotations
 
+import queue
 import random
 import threading
 import time
@@ -247,6 +248,284 @@ class ServerStream:
         self.cancel()
 
 
+class StreamSession:
+    """One live bidi ingest stream (ISSUE 18): the client half of
+    ``InsertStream``/``QueryStream``. Obtain via
+    :meth:`BloomClient.insert_stream` / :meth:`BloomClient.query_stream`
+    and use as a context manager; :meth:`send` ships one seq-stamped
+    frame (blocking only when the server's credit window is exhausted —
+    that IS the flow control), acks are consumed by a background reader
+    and surfaced through :meth:`result` / :meth:`drain`.
+
+    Exactly-once replay: every frame keeps its ORIGINAL rid for its
+    whole lifetime. When the transport dies mid-stream (server SIGKILL,
+    network cut), the next ``send``/``drain`` reconnects — refreshing
+    the topology first when sentinels are configured — and re-sends
+    only the still-unacked frames, in seq order, under those original
+    rids; the server's rid→response dedup cache (rebuilt from the op
+    log's merged-record ``parts`` across restarts) answers any frame
+    whose first flight already applied, so nothing double-applies even
+    on counting filters. Reconnects are budgeted like unary retries
+    (``client.max_retries``, reset by any successful ack).
+
+    Single-producer: one thread drives ``send``/``drain``/``result``;
+    the internal reader is the only other toucher of session state.
+    """
+
+    def __init__(self, client: "BloomClient", method: str, name: str,
+                 *, defaults: Optional[dict] = None):
+        self._client = client
+        self._method = method  # "InsertStream" | "QueryStream"
+        self._name = name
+        self._defaults = dict(defaults or {})
+        self._cond = locks.named_condition("client.stream")
+        self._seq = 0
+        #: seq -> frame dict still awaiting its ack — THE replay source
+        self._unacked: dict = {}
+        self._results: dict = {}
+        self._credit = 0  # 0 until the server's hello grants a window
+        self._broken: Optional[BaseException] = None
+        self._failed: Optional[BaseException] = None
+        self._closed = False
+        self._connects = 0
+        self._sendq: "queue.Queue" = queue.Queue()
+        self._call = None
+        self._reader: Optional[threading.Thread] = None
+        self._connect()
+
+    # -- transport ------------------------------------------------------------
+
+    def _connect(self) -> None:
+        self._sendq = sendq = queue.Queue()
+
+        def frames():
+            while True:
+                item = sendq.get()
+                if item is None:
+                    return
+                yield item
+
+        call = self._client._bidi_calls[self._method](frames(), timeout=None)
+        with self._cond:
+            self._call = call
+            self._credit = 0
+            self._broken = None
+        # replay first, in seq order, original rids: these frames were
+        # inside the PREVIOUS grant's window, so jumping the fresh
+        # hello is at worst a brief over-send the server parks
+        for seq in sorted(self._unacked):
+            sendq.put(protocol.encode(self._unacked[seq]))
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(call,),
+            name="tpubloom-stream-reader", daemon=True,
+        )
+        self._reader.start()
+
+    def _read_loop(self, call) -> None:
+        client = self._client
+        try:
+            for raw in call:
+                frame = protocol.decode(raw)
+                kind = frame.get("kind")
+                if kind == "hello":
+                    with self._cond:
+                        self._credit = max(1, int(frame.get("credit") or 1))
+                        self._cond.notify_all()
+                    continue
+                if kind != "ack":
+                    continue
+                resp = frame.get("resp") or {}
+                if resp.get("repl_seq") is not None:
+                    client.last_write_seq = int(resp["repl_seq"])
+                seq = frame.get("seq")
+                with self._cond:
+                    self._unacked.pop(seq, None)
+                    if seq is not None:
+                        self._results[seq] = resp
+                    self._credit = max(1, int(frame.get("credit") or 1))
+                    self._connects = 0  # progress resets the budget
+                    self._cond.notify_all()
+        except grpc.RpcError as e:
+            with self._cond:
+                if self._call is call and not self._closed:
+                    self._broken = e
+                self._cond.notify_all()
+            return
+        # clean end-of-stream with frames unanswered = the server died
+        # after half-close but before draining — same replay path
+        with self._cond:
+            if self._call is call and self._unacked and not self._closed:
+                self._broken = protocol.BloomServiceError(
+                    "UNAVAILABLE",
+                    f"{self._method} ended with "
+                    f"{len(self._unacked)} unacked frame(s)",
+                )
+            self._cond.notify_all()
+
+    def _reconnect(self) -> None:
+        client = self._client
+        with self._cond:
+            err = self._broken
+            if err is None:
+                return
+            self._connects += 1
+            n = self._connects
+            if n > client.max_retries:
+                self._failed = err
+                raise err
+        old = self._call
+        if old is not None:
+            old.cancel()
+        reader = self._reader
+        if reader is not None:
+            reader.join(timeout=5.0)
+        time.sleep(
+            min(client.backoff_max, client.backoff_base * (2 ** (n - 1)))
+            * (0.5 + random.random())
+        )
+        moved = False
+        if client.sentinels:
+            # the primary may have MOVED across the kill — follow the
+            # sentinels' view before replaying (the rebuilt _bidi_calls
+            # point at the fresh channel)
+            try:
+                moved = client.refresh_topology()
+            except Exception:  # noqa: BLE001 — reconnect is best-effort
+                pass
+        if not moved:
+            # same address: swap the dead channel for a fresh one, or
+            # gRPC's grown connect backoff makes every remaining retry
+            # fail fast against the stale subchannel while the server
+            # restart is already accepting connections
+            client._rebuild_primary_channel()
+        self._connect()
+
+    # -- producer API ---------------------------------------------------------
+
+    def send(self, keys, **overrides) -> int:
+        """Ship one frame; returns its seq. Blocks while the credit
+        window is full (or the hello has not landed yet) — the server's
+        backpressure, not an error. ``overrides`` are per-frame wire
+        fields (``return_presence``, ``min_replicas``, ...)."""
+        locks.note_blocking("client.stream")
+        client = self._client
+        if self._failed is not None:
+            raise self._failed
+        self._seq += 1
+        seq = self._seq
+        frame = {"seq": seq, "rid": new_rid(), "name": self._name}
+        frame.update(self._defaults)
+        frame.update(overrides)
+        client._encode_keys(frame, keys)
+        if (
+            self._method == "InsertStream"
+            and client.epoch is not None
+            and "epoch" not in frame
+        ):
+            frame["epoch"] = client.epoch
+        if client.trace_sample > 0 and obs_trace.hit(
+            frame["rid"], client.trace_sample
+        ):
+            frame["trace"] = {
+                "forced": True, "span": obs_trace.new_span_id(),
+            }
+        while True:
+            with self._cond:
+                if self._failed is not None:
+                    raise self._failed
+                broken = self._broken
+                if broken is None:
+                    if len(self._unacked) < self._credit:
+                        self._unacked[seq] = frame
+                        sendq = self._sendq
+                        break
+                    self._cond.wait(timeout=0.05)
+                    continue
+            self._reconnect()
+        sendq.put(protocol.encode(frame))
+        return seq
+
+    def drain(self, timeout: float = 60.0) -> list:
+        """Block until every sent frame is acked (reconnecting/replaying
+        as needed); returns the raw per-frame responses in seq order.
+        Per-frame verdicts — including error maps — are the entries;
+        use :meth:`result` for raise-on-error access to one frame."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if self._failed is not None:
+                    raise self._failed
+                broken = self._broken
+                if broken is None:
+                    if not self._unacked:
+                        return [
+                            self._results[s] for s in sorted(self._results)
+                        ]
+                    self._cond.wait(timeout=0.05)
+            if broken is not None:
+                self._reconnect()
+            if time.monotonic() > deadline:
+                raise protocol.BloomServiceError(
+                    "DEADLINE_EXCEEDED",
+                    f"stream drain: {len(self._unacked)} frame(s) still "
+                    f"unacked after {timeout:.0f}s",
+                )
+
+    def result(self, seq: int, timeout: float = 60.0) -> dict:
+        """This frame's verdict, exactly as the unary call would have
+        answered (raises :class:`protocol.BloomServiceError` on an
+        error verdict — ``protocol.check`` semantics)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if seq in self._results:
+                    return protocol.check(dict(self._results[seq]))
+                if self._failed is not None:
+                    raise self._failed
+                broken = self._broken
+                if broken is None:
+                    self._cond.wait(timeout=0.05)
+            if broken is not None:
+                self._reconnect()
+            if time.monotonic() > deadline:
+                raise protocol.BloomServiceError(
+                    "DEADLINE_EXCEEDED",
+                    f"stream result: seq {seq} unacked after {timeout:.0f}s",
+                )
+
+    @property
+    def unacked(self) -> int:
+        with self._cond:
+            return len(self._unacked)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain (best-effort), half-close the send side, wait for the
+        server to finish the stream. Never raises — a session used via
+        ``with`` must tear down even after a terminal failure."""
+        with self._cond:
+            if self._closed:
+                return
+        try:
+            self.drain(timeout=timeout)
+        except Exception:  # noqa: BLE001 — teardown path
+            pass
+        with self._cond:
+            self._closed = True
+        self._sendq.put(None)
+        reader = self._reader
+        if reader is not None:
+            reader.join(timeout=timeout)
+        call = self._call
+        if call is not None:
+            call.cancel()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class BloomClient:
     """Blocking client; one instance per channel, filters addressed by name."""
 
@@ -350,6 +629,7 @@ class BloomClient:
         self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._calls = self._make_calls(self._channel)
         self._stream_calls = self._make_stream_calls(self._channel)
+        self._bidi_calls = self._make_bidi_calls(self._channel)
         #: (address, channel, calls) per read replica, round-robined
         self._replicas: list = []
         for addr in replicas or ():
@@ -392,6 +672,17 @@ class BloomClient:
                 response_deserializer=lambda b: b,
             )
             for m in protocol.STREAM_METHODS
+        }
+
+    @staticmethod
+    def _make_bidi_calls(channel) -> dict:
+        return {
+            m: channel.stream_stream(
+                protocol.method_path(m),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            for m in protocol.BIDI_STREAM_METHODS
         }
 
     def _call_once(
@@ -454,6 +745,7 @@ class BloomClient:
         self._channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._calls = self._make_calls(self._channel)
         self._stream_calls = self._make_stream_calls(self._channel)
+        self._bidi_calls = self._make_bidi_calls(self._channel)
         # per-CONNECTION capability: the new primary re-negotiates
         self._fixed_negotiated = None
         if close_old:
@@ -485,6 +777,25 @@ class BloomClient:
                 self._retire_channel(ch)
         self._replicas = fresh
         self._rr = 0
+
+    def _rebuild_primary_channel(self) -> None:
+        """Re-dial the primary on a FRESH channel (same address). A
+        killed server leaves the old channel in TRANSIENT_FAILURE with
+        gRPC's internal connect backoff growing toward minutes, so
+        calls created on it fail fast without ever re-dialing — a
+        stream reconnect budget can exhaust while the server is already
+        back up. Swapping the channel makes each budgeted retry perform
+        an immediate dial instead. The old channel is retired, not
+        closed — sibling threads may still have calls in flight on it."""
+        with self._topo_lock:
+            old = self._channel
+            self._channel = grpc.insecure_channel(
+                self.address, options=_CHANNEL_OPTIONS
+            )
+            self._calls = self._make_calls(self._channel)
+            self._stream_calls = self._make_stream_calls(self._channel)
+            self._bidi_calls = self._make_bidi_calls(self._channel)
+            self._retire_channel(old)
 
     def _retire_channel(self, ch) -> None:
         self._retired_channels.append(ch)
@@ -1143,6 +1454,42 @@ class BloomClient:
         return ServerStream(
             self._stream_calls["Monitor"](protocol.encode(req), timeout=None)
         )
+
+    def insert_stream(
+        self,
+        name: str,
+        *,
+        return_presence: bool = False,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> "StreamSession":
+        """Open a persistent ``InsertStream`` (ISSUE 18): one bidi RPC
+        carrying many seq-stamped insert frames with pipelined per-frame
+        acks — InsertBatch semantics per frame (presence fusion,
+        durability quorums, dedup replay safety) without per-call RPC
+        setup. The keyword defaults stamp every frame; ``send`` can
+        override per frame. Use as a context manager::
+
+            with client.insert_stream("events") as s:
+                for batch in batches:
+                    s.send(batch)
+                results = s.drain()
+        """
+        defaults: dict = {}
+        if return_presence:
+            defaults["return_presence"] = True
+        if min_replicas is not None:
+            defaults["min_replicas"] = int(min_replicas)
+        if min_replicas_timeout_ms is not None:
+            defaults["min_replicas_timeout_ms"] = int(min_replicas_timeout_ms)
+        return StreamSession(self, "InsertStream", name, defaults=defaults)
+
+    def query_stream(self, name: str) -> "StreamSession":
+        """Open a persistent ``QueryStream``: QueryBatch semantics per
+        frame, acks carry packed hit bitmaps (unpack with
+        ``np.unpackbits(np.frombuffer(resp["hits"], np.uint8),
+        count=resp["n"])``)."""
+        return StreamSession(self, "QueryStream", name)
 
     def repl_stream(self, cursor: Optional[int] = None) -> "ServerStream":
         """Raw access to the replication changefeed (what a replica

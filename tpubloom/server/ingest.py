@@ -120,6 +120,7 @@ class _Entry:
         "req", "rid", "nkeys", "nbytes", "rows", "keys",
         "want_presence", "replay_unsafe", "min_replicas",
         "timeout_ms", "enq_t", "event", "resp", "error", "trace",
+        "callback",
     )
 
     def __init__(self, req: dict, *, rows, keys, replay_unsafe: bool):
@@ -144,11 +145,24 @@ class _Entry:
         self.event = threading.Event()
         self.resp: Optional[dict] = None
         self.error: Optional[BaseException] = None
+        #: streaming ingest (ISSUE 18): set by :meth:`submit_nowait` —
+        #: fires on the completing thread (dispatcher/completer, always
+        #: OUTSIDE coalescer and filter locks) instead of a parked
+        #: handler thread waking on the event
+        self.callback = None
 
     def complete(self, resp: Optional[dict] = None,
                  error: Optional[BaseException] = None) -> None:
         self.resp, self.error = resp, error
         self.event.set()
+        cb = self.callback
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a bad ack sink must not
+                # fail the flush's OTHER waiters (the stream may have
+                # disconnected between park and completion)
+                log.exception("ingest completion callback failed")
 
 
 class IngestCoalescer:
@@ -262,15 +276,10 @@ class IngestCoalescer:
         "Clear": "clear",
     }
 
-    def submit(self, method: str, req: dict, *,
-               replay_unsafe: bool = False) -> Optional[dict]:
-        """Park one request until its flush completes; returns the
-        demuxed response (or raises its error). Returns **None** when
-        the coalescer is stopped/stopping — the handler falls back to
-        the direct path instead of parking on a dead queue."""
+    def _make_entry(self, method: str, req: dict,
+                    replay_unsafe: bool) -> _Entry:
         from tpubloom.server import protocol
 
-        faults.fire("ingest.coalesce")
         rows = keys = None
         kind = self._KINDS[method]
         fx = protocol.fixed_keys(req)
@@ -283,11 +292,14 @@ class IngestCoalescer:
             keys = req.get("keys") if kind != "clear" else []
             if keys is None:
                 keys = []
-        entry = _Entry(req, rows=rows, keys=keys, replay_unsafe=replay_unsafe)
-        name = req["name"]
+        return _Entry(req, rows=rows, keys=keys, replay_unsafe=replay_unsafe)
+
+    def _park(self, entry: _Entry, name: str, kind: str) -> bool:
+        """Queue one entry under the bounded-park budget; False when
+        the coalescer is stopped/stopping."""
         with self._cond:
             if self._stop:
-                return None
+                return False
             # bounded queue: block (briefly, repeatedly) until there is
             # room — the dispatcher drains continuously, so this is
             # backpressure, not a deadlock risk (and the timeout keeps
@@ -299,11 +311,33 @@ class IngestCoalescer:
             ):
                 self._cond.wait(timeout=0.05)
             if self._stop:
-                return None
+                return False
             self._groups.setdefault((name, kind), []).append(entry)
             self._parked_keys += entry.nkeys
             obs_counters.set_gauge("ingest_parked_current", self._parked_keys)
             self._cond.notify_all()
+        return True
+
+    def parked_budget_left(self) -> int:
+        """Headroom under ``max_parked_keys`` right now — the signal
+        the streaming plane's credit grants follow (ISSUE 18)."""
+        with self._cond:
+            return max(0, self.config.max_parked_keys - self._parked_keys)
+
+    def submit(self, method: str, req: dict, *,
+               replay_unsafe: bool = False) -> Optional[dict]:
+        """Park one request until its flush completes; returns the
+        demuxed response (or raises its error). Returns **None** when
+        the coalescer is stopped/stopping — the handler falls back to
+        the direct path instead of parking on a dead queue."""
+        from tpubloom.server import protocol
+
+        faults.fire("ingest.coalesce")
+        kind = self._KINDS[method]
+        entry = self._make_entry(method, req, replay_unsafe)
+        name = req["name"]
+        if not self._park(entry, name, kind):
+            return None
         budget = self._entry_budget(entry)
         with obs_trace.span("ingest.park", filter=name, op=kind):
             done = entry.event.wait(timeout=budget)
@@ -315,6 +349,26 @@ class IngestCoalescer:
         if entry.error is not None:
             raise entry.error
         return entry.resp
+
+    def submit_nowait(self, method: str, req: dict, *,
+                      replay_unsafe: bool = False, callback) -> bool:
+        """Park one request WITHOUT waiting for its flush (the
+        streaming ingest plane, ISSUE 18): ``callback(entry)`` fires on
+        the completing thread — outside every coalescer/filter lock —
+        once the flush demuxed this entry's verdict into ``entry.resp``
+        / ``entry.error``. Returns False when the coalescer is
+        stopped/stopping (the caller drives the direct path instead).
+
+        The bounded-park backpressure still applies to the CALLING
+        thread: a stream's receiver blocking here until the dispatcher
+        drains is exactly how an over-budget server parks the stream
+        (gRPC/TCP flow control pushes back on the sender) instead of
+        shedding it."""
+        faults.fire("ingest.coalesce")
+        kind = self._KINDS[method]
+        entry = self._make_entry(method, req, replay_unsafe)
+        entry.callback = callback
+        return self._park(entry, req["name"], kind)
 
     def _entry_budget(self, entry: _Entry) -> float:
         """Generous completion budget: flush deadline + the longest
@@ -550,6 +604,23 @@ class IngestCoalescer:
         )
 
     @staticmethod
+    def _log_parts(logged: dict, entries: list) -> None:
+        """Stamp the merged record with its replay-unsafe constituents
+        (ISSUE 18): ``parts = [[rid, nkeys], ...]``. A merged record
+        used to carry only the FLUSH rid, so a restart (or a promoted
+        replica) could not answer a parked request's own rid from the
+        dedup cache — a client replaying an applied-but-unacked
+        counting insert after a crash would double-apply. Replaying the
+        record now re-seeds one dedup entry per part
+        (:meth:`BloomService.apply_record`)."""
+        parts = [
+            [e.rid, e.nkeys]
+            for e in entries if e.replay_unsafe and e.rid
+        ]
+        if parts:
+            logged["parts"] = parts
+
+    @staticmethod
     def _demote_wide_rows(mf, rows, keys):
         """Fixed-width keys WIDER than the filter's key_len cannot take
         the packed path — materialize the list so ``key_policy``
@@ -665,6 +736,7 @@ class IngestCoalescer:
                     }
                 else:
                     logged["keys"] = keys
+                self._log_parts(logged, entries)
                 seq = service._log_op("InsertBatch", logged, mf)
                 if mf.checkpointer:
                     mf.checkpointer.notify_inserts(
@@ -725,6 +797,7 @@ class IngestCoalescer:
                     }
                 else:
                     logged["keys"] = keys
+                self._log_parts(logged, entries)
                 seq = service._log_op("DeleteBatch", logged, mf)
         if fallback:
             self._fallback_direct(entries, method="DeleteBatch")

@@ -97,6 +97,52 @@ CLIENT_STREAM_METHODS = (
     "ReplAck",
 )
 
+#: Bidirectional-streaming RPCs (ISSUE 18 — the streaming ingest
+#: plane): one persistent stream amortizes transport the way the
+#: coalescer amortizes device launches. Every frame in BOTH directions
+#: is one msgpack map.
+#:
+#: Client→server DATA frames (both methods)::
+#:
+#:     {"seq": <client frame seq, 1-based, monotone per stream>,
+#:      "rid": <frame request id — retained across reconnect replays>,
+#:      "name": <filter>,
+#:      "keys_fixed": {"data", "width", "n"}   # or "keys": [b, ...]
+#:      # InsertStream only, all optional:
+#:      "return_presence": bool, "min_replicas": int,
+#:      "min_replicas_timeout_ms": int, "epoch": int}
+#:
+#: Server→client ACK frames: the FIRST frame on every stream is
+#: ``{"kind": "hello", "credit": <initial window>}``; afterwards one
+#: ``{"kind": "ack", "seq": <echoed frame seq>, "credit": <fresh
+#: window>, "resp": <the full unary-shaped response map>}`` per data
+#: frame — NOT necessarily in frame order (split insert flushes,
+#: multi-filter groups, and direct-path interleave reorder
+#: completions); each ack echoes its frame's ``seq``, so match on
+#: that. ``resp`` is EXACTLY what the unary
+#: ``InsertBatch``/``QueryBatch`` would have answered (``ok/n``,
+#: presence/hits bitmaps, ``repl_seq``, quorum verdicts from the
+#: one-barrier-per-flush path, or an ``error`` map) — acks are
+#: pipelined, so many frames ride one coalesced flush.
+#:
+#: Flow control: ``credit`` is the number of UNACKED data frames the
+#: client may have in flight, derived from the coalescer's parked-key
+#: budget (``ingest_parked_current`` vs ``max_parked_keys``). Grants
+#: only ride ack frames and never drop below 1 — an over-budget server
+#: PARKS the stream (acks slow down, the window shrinks toward 1)
+#: instead of shedding.
+#:
+#: Exactly-once replay: a client whose stream died mid-flight
+#: reconnects and re-sends ONLY its unacked frames under their ORIGINAL
+#: rids; the server's rid→response dedup cache (ISSUE 2/3, rebuilt from
+#: the op log's per-frame ``parts`` on restart) answers any frame whose
+#: first flight already applied from cache — zero double-applies, even
+#: for counting-filter inserts.
+BIDI_STREAM_METHODS = (
+    "InsertStream",
+    "QueryStream",
+)
+
 #: Mutating RPCs: replicated through the op log, rejected with
 #: ``READONLY`` on replicas (Redis ``replica-read-only`` parity). A
 #: mutating request MAY carry the caller's cached topology ``epoch``

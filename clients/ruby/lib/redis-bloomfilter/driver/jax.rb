@@ -38,6 +38,9 @@ class Redis
           InsertBatch QueryBatch DeleteBatch Clear Stats Checkpoint Wait
           SlowlogGet SlowlogReset TraceGet Promote ReplicaOf
           ClusterSlots ClusterSetSlot MigrateSlot MigrateInstall
+          CFReserve CFAdd CFDel CFExists
+          CMSInitByDim CMSIncrBy CMSQuery
+          TopKReserve TopKAdd TopKList
         ].freeze
 
         IDENTITY = proc { |bytes| bytes }
@@ -269,6 +272,109 @@ class Redis
           rpc("ReplicaOf", req, no_retry: true)
         end
 
+        # -- sketch plane (ISSUE 19): RedisBloom CF.*/CMS.*/TOPK. parity
+        #
+        # Kind-specific verbs on NAMED sketches (a driver instance is
+        # bound to one bloom filter via :key_name, but sketches are
+        # sibling keys — so every sketch verb takes the name
+        # explicitly, mirroring the RedisBloom command shapes).
+
+        # CF.RESERVE: create a cuckoo filter sized for capacity keys.
+        def cf_reserve(name, capacity, **options)
+          req = { "name" => name, "capacity" => capacity, "exist_ok" => true }
+          req["options"] = options unless options.empty?
+          rpc("CFReserve", req)
+          true
+        end
+
+        # CF.ADD (batched): one boolean per key — false where the
+        # honestly-FULL table rejected the insert. Never auto-retried:
+        # cuckoo inserts are multiset adds with no idempotent replay.
+        def cf_add(name, keys, min_replicas: nil)
+          resp = rpc(
+            "CFAdd",
+            durability(encode_keys({ "name" => name }, keys), min_replicas),
+            no_retry: true
+          )
+          return Array.new(resp["n"], true) unless resp["full"]
+          unpack_bits(resp["full"], resp["n"]).map { |rejected| !rejected }
+        end
+
+        # CF.DEL (batched): removes ONE stored copy per key; returns one
+        # boolean per key — true where a copy existed. Retries reuse the
+        # rid and the server's dedup cache absorbs replays.
+        def cf_del(name, keys, min_replicas: nil)
+          resp = rpc(
+            "CFDel",
+            durability(encode_keys({ "name" => name }, keys), min_replicas)
+          )
+          unpack_bits(resp["deleted"], resp["n"])
+        end
+
+        # CF.EXISTS (batched): no false negatives.
+        def cf_exists?(name, keys)
+          resp = rpc("CFExists", encode_keys({ "name" => name }, keys))
+          unpack_bits(resp["hits"], resp["n"])
+        end
+
+        # CMS.INITBYDIM: width rounds up server-side to a multiple of 32.
+        def cms_init_by_dim(name, width, depth, **options)
+          req = {
+            "name" => name, "width" => width, "depth" => depth,
+            "exist_ok" => true
+          }
+          req["options"] = options unless options.empty?
+          rpc("CMSInitByDim", req)
+          true
+        end
+
+        # CMS.INCRBY: weighted increments answer the post-update
+        # estimates; unit increments (increments: nil) ride the
+        # server's coalesced insert path and answer nil — follow with
+        # #cms_query when the counts are needed. Weighted calls are
+        # replay-guarded by the rid dedup cache server-side.
+        def cms_incrby(name, keys, increments: nil, min_replicas: nil)
+          req = durability(
+            encode_keys({ "name" => name }, keys), min_replicas
+          )
+          req["increments"] = increments if increments
+          rpc("CMSIncrBy", req)["counts"]
+        end
+
+        # CMS.QUERY: point estimates, each only ever >= the true count.
+        def cms_query(name, keys)
+          rpc("CMSQuery", { "name" => name, "keys" => keys.map(&:to_s) })["counts"]
+        end
+
+        # TOPK.RESERVE: top-k heavy hitters over a CMS backing array.
+        def topk_reserve(name, topk, width: 2048, depth: 5, **options)
+          req = {
+            "name" => name, "topk" => topk, "width" => width,
+            "depth" => depth, "exist_ok" => true
+          }
+          req["options"] = options unless options.empty?
+          rpc("TopKReserve", req)
+          true
+        end
+
+        # TOPK.ADD (unit counts). Never auto-retried — counting adds
+        # have no idempotent replay; the rid dedup covers a landed
+        # first flight.
+        def topk_add(name, keys, min_replicas: nil)
+          rpc(
+            "TopKAdd",
+            durability(encode_keys({ "name" => name }, keys), min_replicas),
+            no_retry: true
+          )["n"]
+        end
+
+        # TOPK.LIST WITHCOUNT: [[key, estimate], ...] descending.
+        def topk_list(name)
+          rpc("TopKList", { "name" => name })["items"].map do |item|
+            [item["key"], item["count"]]
+          end
+        end
+
         # -- streaming ingest plane (ISSUE 18) -------------------------
         #
         # One persistent bidi RPC carries many seq-stamped key frames;
@@ -455,7 +561,8 @@ class Redis
         end
 
         MUTATING = %w[CreateFilter DropFilter InsertBatch DeleteBatch
-                      Clear].freeze
+                      Clear CFReserve CFAdd CFDel CMSInitByDim CMSIncrBy
+                      TopKReserve TopKAdd].freeze
 
         def rpc(method, payload, no_retry: false)
           no_retry ||= method == "InsertBatch" && counting?

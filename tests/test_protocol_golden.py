@@ -100,6 +100,45 @@ GOLDEN = {
     "MigrateInstall": (
         "MigrateInstall", "82a46e616d65a6676f6c64656ea570726f6265c3"
     ),
+    # sketch plane (ISSUE 19): RedisBloom CF.*/CMS.*/TOPK.* parity verbs
+    # — the exact bytes the Ruby driver's cf_*/cms_*/topk_* helpers send
+    "CFReserve": (
+        "CFReserve",
+        "83a46e616d65a9676f6c64656e2d6366a8636170616369747964a865786973745f6f6bc3",
+    ),
+    "CFAdd": (
+        "CFAdd",
+        "82a46e616d65a9676f6c64656e2d6366a46b65797392c40463662d31c40463662d32",
+    ),
+    "CFDel": (
+        "CFDel",
+        "82a46e616d65a9676f6c64656e2d6366a46b65797391c40463662d32",
+    ),
+    "CFExists": (
+        "CFExists",
+        "82a46e616d65a9676f6c64656e2d6366a46b65797393c40463662d31c40463662d32c406616273656e74",
+    ),
+    "CMSInitByDim": (
+        "CMSInitByDim",
+        "84a46e616d65aa676f6c64656e2d636d73a5776964746840a5646570746803a865786973745f6f6bc3",
+    ),
+    "CMSIncrBy": (
+        "CMSIncrBy",
+        "83a46e616d65aa676f6c64656e2d636d73a46b65797392c4036b2d61c4036b2d62aa696e6372656d656e7473920502",
+    ),
+    "CMSQuery": (
+        "CMSQuery",
+        "82a46e616d65aa676f6c64656e2d636d73a46b65797393c4036b2d61c4036b2d62c406616273656e74",
+    ),
+    "TopKReserve": (
+        "TopKReserve",
+        "85a46e616d65ab676f6c64656e2d746f706ba4746f706b02a5776964746840a5646570746803a865786973745f6f6bc3",
+    ),
+    "TopKAdd": (
+        "TopKAdd",
+        "82a46e616d65ab676f6c64656e2d746f706ba46b65797393c403686f74c403686f74c404636f6c64",
+    ),
+    "TopKList": ("TopKList", "81a46e616d65ab676f6c64656e2d746f706b"),
 }
 
 #: one ``ReplAck`` client-streaming frame (ISSUE 5) — the exact bytes a
@@ -191,6 +230,21 @@ GOLDEN_DICTS = {
     "ClusterSetSlot": {"slot": 7, "state": "stable"},
     "MigrateSlot": {"slot": 7, "target": "127.0.0.1:1"},
     "MigrateInstall": {"name": "golden", "probe": True},
+    "CFReserve": {"name": "golden-cf", "capacity": 100, "exist_ok": True},
+    "CFAdd": {"name": "golden-cf", "keys": [b"cf-1", b"cf-2"]},
+    "CFDel": {"name": "golden-cf", "keys": [b"cf-2"]},
+    "CFExists": {"name": "golden-cf",
+                 "keys": [b"cf-1", b"cf-2", b"absent"]},
+    "CMSInitByDim": {"name": "golden-cms", "width": 64, "depth": 3,
+                     "exist_ok": True},
+    "CMSIncrBy": {"name": "golden-cms", "keys": [b"k-a", b"k-b"],
+                  "increments": [5, 2]},
+    "CMSQuery": {"name": "golden-cms",
+                 "keys": [b"k-a", b"k-b", b"absent"]},
+    "TopKReserve": {"name": "golden-topk", "topk": 2, "width": 64,
+                    "depth": 3, "exist_ok": True},
+    "TopKAdd": {"name": "golden-topk", "keys": [b"hot", b"hot", b"cold"]},
+    "TopKList": {"name": "golden-topk"},
 }
 
 
@@ -379,6 +433,86 @@ def test_golden_replay_against_live_server(raw_server):
     r = msgpack.unpackb(fn(bad), raw=False)
     assert r["ok"] is False and r["error"]["code"] == "NOT_FOUND"
     assert isinstance(r["error"]["message"], str)
+
+
+def test_golden_sketch_replay(raw_service_server):
+    """Sketch-plane goldens (ISSUE 19) replayed RAW against a live
+    server: every CF.*/CMS.*/TOPK.* response field the Ruby driver
+    reads, plus the WRONG_TYPE / READONLY / CLUSTER_DISABLED error
+    shapes kind-specific verbs answer."""
+    ch, service = raw_service_server
+
+    # cuckoo: reserve -> add -> exists -> del -> exists
+    assert _call(ch, *GOLDEN["CFReserve"])["ok"]
+    r = _call(ch, *GOLDEN["CFAdd"])
+    assert r["ok"] and r["n"] == 2
+    assert "full" not in r, "a near-empty table must reject nothing"
+    r = _call(ch, *GOLDEN["CFExists"])
+    assert r["ok"] and r["n"] == 3 and isinstance(r["hits"], bytes)
+    bits = np.unpackbits(np.frombuffer(r["hits"], np.uint8), bitorder="big")[:3]
+    assert bits[0] and bits[1] and not bits[2]
+    r = _call(ch, *GOLDEN["CFDel"])
+    assert r["ok"] and r["n"] == 1 and isinstance(r["deleted"], bytes)
+    bits = np.unpackbits(np.frombuffer(r["deleted"], np.uint8), bitorder="big")
+    assert bits[0], "cf-2 was stored — its delete must report existed"
+    r = _call(ch, *GOLDEN["CFExists"])
+    bits = np.unpackbits(np.frombuffer(r["hits"], np.uint8), bitorder="big")[:3]
+    assert bits[0] and not bits[1], "deleted key must be gone (no FN before)"
+
+    # count-min: init -> weighted incrby answers post-update estimates
+    assert _call(ch, *GOLDEN["CMSInitByDim"])["ok"]
+    r = _call(ch, *GOLDEN["CMSIncrBy"])
+    assert r["ok"] and r["n"] == 2
+    assert r["counts"][0] >= 5 and r["counts"][1] >= 2
+    r = _call(ch, *GOLDEN["CMSQuery"])
+    assert r["ok"] and r["n"] == 3 and len(r["counts"]) == 3
+    assert r["counts"][0] >= 5 and r["counts"][1] >= 2
+
+    # top-k: reserve -> add unit counts -> list heavy hitters
+    assert _call(ch, *GOLDEN["TopKReserve"])["ok"]
+    r = _call(ch, *GOLDEN["TopKAdd"])
+    assert r["ok"] and r["n"] == 3
+    r = _call(ch, *GOLDEN["TopKList"])
+    assert r["ok"] and len(r["items"]) >= 1
+    top = r["items"][0]
+    assert top["key"] == b"hot" and top["count"] >= 2
+
+    # WRONG_TYPE (Redis WRONGTYPE parity): a CF verb on a CMS key
+    wrong = msgpack.packb(
+        {"name": "golden-cms", "keys": [b"x"]}, use_bin_type=True
+    )
+    fn = ch.unary_unary(
+        protocol.method_path("CFAdd"),
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    r = msgpack.unpackb(fn(wrong), raw=False)
+    assert r["ok"] is False and r["error"]["code"] == "WRONG_TYPE"
+
+    # READONLY: the mutating sketch verbs answer the same structured
+    # refusal bloom writes do on a replica (the driver's failover path)
+    service.read_only = True
+    try:
+        for fixture in ("CFAdd", "CFDel", "CMSIncrBy", "TopKAdd"):
+            r = _call(ch, *GOLDEN[fixture])
+            assert r["ok"] is False, fixture
+            assert r["error"]["code"] == "READONLY", fixture
+        # read verbs keep serving on a replica
+        assert _call(ch, *GOLDEN["CFExists"])["ok"]
+        assert _call(ch, *GOLDEN["CMSQuery"])["ok"]
+        assert _call(ch, *GOLDEN["TopKList"])["ok"]
+    finally:
+        service.read_only = False
+
+
+def test_golden_sketch_cluster_disabled(raw_server):
+    """Keyed sketch verbs on a NON-cluster server: no slot check, no
+    CLUSTER_DISABLED — they serve like any keyed bloom verb (the
+    cluster error shape is reserved for the admin/migration verbs,
+    asserted in test_golden_replay_against_live_server)."""
+    assert _call(raw_server, *GOLDEN["CFReserve"])["ok"]
+    r = _call(raw_server, *GOLDEN["CFAdd"])
+    assert r["ok"] and r["n"] == 2
 
 
 def test_golden_stream_replay(tmp_path):

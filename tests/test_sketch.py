@@ -1,0 +1,616 @@
+"""Sketch plane (ISSUE 19): cuckoo filters + count-min / top-k sketches
+as pluggable filter kinds.
+
+Layers covered:
+
+* the cuckoo kernels — insert/query/delete round trips, the fixed-trip
+  kick bound, the honest-FULL invariant (ok count == occupied slots:
+  a failed kick chain unwinds its evictions EXACTLY, no collateral
+  damage), multiset insert + one-copy delete;
+* the count-min kernels — estimates are an upper bound on the exact
+  multiset counts, within the classic 2N/width error bound for the
+  configured depth, duplicate keys within one batch accumulate;
+* config + kind registry — validation, identity round trips, old
+  (pre-kind) checkpoint headers defaulting to "bloom";
+* checkpoint round trips per kind, including the top-k heap riding the
+  header's extra block;
+* the inherited planes — sketch kinds coalesce (keys_fixed demux,
+  per-request FULL slices), replicate under a ``min_replicas=1``
+  quorum, and migrate via ``MigrateSlot`` with counts intact;
+* fault points — ``cuckoo.kick`` / ``cms.update`` fire per update
+  batch (armed here; the SIGKILL acceptance lives in
+  tests/test_sketch_chaos.py).
+"""
+
+import collections
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tpubloom import checkpoint as ckpt
+from tpubloom import faults
+from tpubloom.cluster import slots as S
+from tpubloom.cluster.node import ClusterState
+from tpubloom.cluster.rebalance import even_ranges
+from tpubloom.config import FilterConfig, identity_mismatch
+from tpubloom.ops import cuckoo as ops_cuckoo
+from tpubloom.repl import OpLog, ReplicaApplier
+from tpubloom.server.client import BloomClient
+from tpubloom.server.ingest import CoalesceConfig
+from tpubloom.server.service import BloomService, build_server
+from tpubloom.sketch import registry
+from tpubloom.sketch.cms import CountMinSketch, TopKSketch
+from tpubloom.sketch.cuckoo import CuckooFilter
+
+pytestmark = pytest.mark.usefixtures("lock_check_armed", "lock_order_manifest")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _cuckoo(m=256, seed=7, name="cf"):
+    return CuckooFilter(FilterConfig(m=m, k=2, seed=seed, kind="cuckoo",
+                                     key_name=name))
+
+
+def _cms(width=64, depth=4, seed=7, name="cms"):
+    return CountMinSketch(FilterConfig(m=width, k=depth, seed=seed,
+                                       kind="cms", key_name=name))
+
+
+def _topk(width=64, depth=4, topk=3, seed=7, name="tk"):
+    return TopKSketch(FilterConfig(m=width, k=depth, seed=seed, kind="topk",
+                                   topk=topk, key_name=name))
+
+
+# -- cuckoo kernels ----------------------------------------------------------
+
+
+def test_cuckoo_alt_bucket_is_an_involution():
+    import jax.numpy as jnp
+
+    mask = 63
+    b = jnp.arange(64, dtype=jnp.uint32)
+    for fp in (1, 77, 0xFFFF):
+        fps = jnp.full((64,), fp, jnp.uint32)
+        alt = ops_cuckoo.alt_bucket(b, fps, mask)
+        back = ops_cuckoo.alt_bucket(alt, fps, mask)
+        assert (np.asarray(back) == np.asarray(b)).all(), (
+            "alt(alt(b)) must be b — the kick chain depends on it"
+        )
+
+
+def test_cuckoo_round_trip_no_false_negatives():
+    f = _cuckoo(m=1024)
+    keys = [b"ck-%04d" % i for i in range(500)]
+    f.insert_batch(keys)
+    flags = f.take_insert_flags()
+    assert flags is not None and flags.all(), "half-full table must accept all"
+    assert f.include_batch(keys).all(), "cuckoo has NO false negatives"
+    absent = [b"zz-%04d" % i for i in range(500)]
+    fpr = f.include_batch(absent).mean()
+    assert fpr < 0.05, f"16-bit fingerprints should keep FPR tiny, got {fpr}"
+
+
+def test_cuckoo_full_is_honest_and_unwinds_exactly():
+    """Overfill a tiny table: every reported ok MUST correspond to a
+    stored fingerprint (ok count == occupied slots — a failed chain
+    that left moved copies behind would break ==), every accepted key
+    remains queryable, and per-key kicks respect MAX_KICKS."""
+    f = _cuckoo(m=64)  # 16 buckets * 4 slots
+    keys = [b"fill-%03d" % i for i in range(128)]  # 2x capacity
+    f.insert_batch(keys)
+    flags = f.take_insert_flags()
+    assert flags is not None and not flags.all(), "overfill must reject"
+    assert flags.any(), "a 2x overfill should still land many keys"
+    occupied = int(round(f.fill_ratio() * f.config.m))
+    assert int(flags.sum()) == occupied, (
+        "honest FULL: accepted count must equal occupied slots exactly "
+        f"(ok={int(flags.sum())}, occupied={occupied})"
+    )
+    accepted = [k for k, ok in zip(keys, flags) if ok]
+    assert f.include_batch(accepted).all(), (
+        "keys acked by the insert must be queryable — no false negatives"
+    )
+
+
+def test_cuckoo_kick_bound_is_static():
+    """The kick loop is a fixed-trip fori_loop: whatever the batch, the
+    per-batch kick total is bounded by B * MAX_KICKS (no unbounded
+    retry loop to hang a TPU step)."""
+    from tpubloom.obs import counters as obs_counters
+
+    before = obs_counters.get("cuckoo_kicks_total")
+    f = _cuckoo(m=64)
+    keys = [b"kb-%03d" % i for i in range(200)]
+    f.insert_batch(keys)
+    f.take_insert_flags()
+    kicks = obs_counters.get("cuckoo_kicks_total") - before
+    assert 0 <= kicks <= 200 * ops_cuckoo.MAX_KICKS
+
+
+def test_cuckoo_multiset_insert_and_one_copy_delete():
+    f = _cuckoo(m=256)
+    f.insert_batch([b"dup", b"dup", b"other"])
+    f.take_insert_flags()
+    # delete ONE copy: still present (the second copy remains)
+    out = f.delete_batch([b"dup"])
+    assert out[0], "a stored copy existed"
+    assert f.include_batch([b"dup"])[0], "second copy must survive"
+    out = f.delete_batch([b"dup"])
+    assert out[0]
+    assert not f.include_batch([b"dup"])[0], "both copies deleted -> gone"
+    assert f.include_batch([b"other"])[0], "unrelated key untouched"
+    # deleting an absent key reports existed=False
+    assert not f.delete_batch([b"never-stored"])[0]
+
+
+def test_cuckoo_kick_chain_property_random_batches():
+    """Property sweep (hypothesis when available, seeded fallback
+    otherwise): for random batch sizes and key sets on a small table,
+    the honest-FULL invariant holds after every batch."""
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    @hyp.given(st.integers(min_value=1, max_value=120), st.integers(0, 2**16))
+    @hyp.settings(max_examples=20, deadline=None)
+    def prop(n, salt):
+        f = _cuckoo(m=64, seed=3)
+        f.insert_batch([b"p-%d-%d" % (salt, i) for i in range(n)])
+        flags = f.take_insert_flags()
+        occupied = int(round(f.fill_ratio() * f.config.m))
+        assert int(flags.sum()) == occupied
+
+    prop()
+
+
+def test_cuckoo_invariant_seeded_sweep():
+    """The same invariant, deterministic (runs whether or not
+    hypothesis is installed)."""
+    for n, salt in [(1, 0), (17, 1), (63, 2), (64, 3), (100, 4), (120, 5)]:
+        f = _cuckoo(m=64, seed=3)
+        f.insert_batch([b"p-%d-%d" % (salt, i) for i in range(n)])
+        flags = f.take_insert_flags()
+        occupied = int(round(f.fill_ratio() * f.config.m))
+        assert int(flags.sum()) == occupied, (n, salt)
+
+
+# -- count-min kernels -------------------------------------------------------
+
+
+def test_cms_estimates_bound_exact_counts():
+    """est >= truth always; est - truth <= 2N/width for every queried
+    key with overwhelming probability at depth 4 (the classic CMS
+    bound, deterministic here via the fixed seed)."""
+    width, depth = 64, 4
+    f = _cms(width=width, depth=depth)
+    rng = np.random.default_rng(11)
+    stream = [b"k-%02d" % rng.integers(0, 30) for _ in range(600)]
+    for off in range(0, len(stream), 100):
+        f.insert_batch(stream[off:off + 100])
+    truth = collections.Counter(stream)
+    keys = sorted(truth)
+    est = f.estimate_batch(keys)
+    n = len(stream)
+    for k, e in zip(keys, est):
+        assert e >= truth[k], f"CMS must never undercount ({k})"
+        assert e - truth[k] <= 2 * n / width + 1, (
+            f"error bound blown for {k}: est={e} true={truth[k]}"
+        )
+    # absent key: estimate is small (bounded by the same error term)
+    absent = f.estimate_batch([b"never-seen"])[0]
+    assert absent <= 2 * n / width + 1
+
+
+def test_cms_duplicates_within_one_batch_accumulate():
+    f = _cms()
+    f.insert_batch([b"x", b"x", b"x", b"y"])
+    est = f.estimate_batch([b"x", b"y"])
+    assert est[0] >= 3 and est[1] >= 1
+
+
+def test_cms_weighted_increments_and_validation():
+    f = _cms()
+    out = f.increment_batch([b"a", b"b"], [10, 3])
+    assert out[0] >= 10 and out[1] >= 3
+    with pytest.raises(ValueError, match="increments"):
+        f.increment_batch([b"a"], [1, 2])
+    with pytest.raises(ValueError, match="u32"):
+        f.increment_batch([b"a"], [-1])
+
+
+def test_topk_heap_tracks_heavy_hitters():
+    f = _topk(topk=2)
+    f.insert_batch([b"hot"] * 5 + [b"warm"] * 3 + [b"cold"])
+    items = f.topk_list()
+    assert [k for k, _ in items] == [b"hot", b"warm"]
+    assert items[0][1] >= 5 and items[1][1] >= 3
+    # serialization round trip (the checkpoint extra block)
+    g = _topk(topk=2)
+    g.load_sketch_extra(f.sketch_extra())
+    assert g.topk_list() == items
+
+
+# -- config + registry -------------------------------------------------------
+
+
+def test_filter_config_kind_validation():
+    with pytest.raises(ValueError, match="kind"):
+        FilterConfig(m=64, k=2, kind="hyperloglog")
+    with pytest.raises(ValueError, match="bloom-family"):
+        FilterConfig(m=64, k=2, kind="cuckoo", counting=True)
+    with pytest.raises(ValueError, match="bloom-family"):
+        FilterConfig(m=64, k=2, kind="cms", block_bits=9)
+    with pytest.raises(ValueError, match="power-of-two"):
+        FilterConfig(m=96, k=2, kind="cuckoo")
+    with pytest.raises(ValueError, match="topk"):
+        FilterConfig(m=64, k=2, kind="topk")  # topk kind needs topk > 0
+    with pytest.raises(ValueError, match="topk"):
+        FilterConfig(m=64, k=2, kind="cms", topk=5)
+    # the happy paths
+    FilterConfig(m=64, k=2, kind="cuckoo")
+    FilterConfig(m=64, k=4, kind="cms")
+    FilterConfig(m=64, k=4, kind="topk", topk=3)
+
+
+def test_registry_surface():
+    assert set(registry.sketch_kinds()) == {"cuckoo", "cms", "topk"}
+    cf = FilterConfig(m=64, k=2, kind="cuckoo")
+    bl = FilterConfig(m=64, k=2)
+    assert registry.kind_of(cf) == "cuckoo" and registry.kind_of(bl) == "bloom"
+    assert registry.is_sketch(cf) and not registry.is_sketch(bl)
+    assert registry.blob_format(cf) == "sketch_cuckoo_le_words"
+    assert registry.replay_unsafe_insert(cf)
+    assert not registry.replay_unsafe_insert(bl)
+    assert registry.supports_delete(cf)
+    assert not registry.supports_delete(FilterConfig(m=64, k=4, kind="cms"))
+    assert isinstance(registry.build(cf), CuckooFilter)
+    with pytest.raises(ValueError, match="unknown"):
+        registry.spec("hyperloglog")
+    # dict-shaped configs (checkpoint headers) resolve the same way
+    assert registry.kind_of({"kind": "cms"}) == "cms"
+    assert registry.kind_of({}) == "bloom"
+
+
+def test_identity_accepts_pre_kind_headers():
+    """A checkpoint header written before ISSUE 19 has no kind/topk
+    field: identity must read it as a bloom filter, not a mismatch."""
+    config = FilterConfig(m=1024, k=4, seed=3)
+    old_header = {f: getattr(config, f) for f in ("m", "k", "seed",
+                                                  "counting", "shards",
+                                                  "block_bits", "block_hash")}
+    assert identity_mismatch(old_header, config) is None
+    newer = FilterConfig(m=1024, k=4, seed=3, kind="cms")
+    assert identity_mismatch(old_header, newer) == "kind"
+
+
+# -- checkpoint round trips --------------------------------------------------
+
+
+def _restart_with_sink(tmp_path, build):
+    """Run ``build`` against a service, checkpoint, then restore into a
+    fresh service over the same sink directory."""
+    def sink_factory(config):
+        return ckpt.FileSink(str(tmp_path / "sink"))
+
+    svc = BloomService(sink_factory=sink_factory)
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    c = BloomClient(f"127.0.0.1:{port}")
+    try:
+        build(c)
+    finally:
+        c.close()
+        srv.stop(grace=None)
+    svc2 = BloomService(sink_factory=sink_factory)
+    srv2, port2 = build_server(svc2, "127.0.0.1:0")
+    srv2.start()
+    return svc2, srv2, BloomClient(f"127.0.0.1:{port2}")
+
+
+def test_cuckoo_checkpoint_round_trip(tmp_path):
+    def build(c):
+        c.cf_reserve("cf", 500)
+        assert c.cf_add("cf", [b"a", b"b", b"c"]).all()
+        c.checkpoint("cf", wait=True)
+
+    svc2, srv2, c2 = _restart_with_sink(tmp_path, build)
+    try:
+        c2.cf_reserve("cf", 500)  # attach restores the checkpoint
+        assert c2.cf_exists("cf", [b"a", b"b", b"c"]).all()
+        assert c2.cf_del("cf", [b"b"]).all()
+        hits = c2.cf_exists("cf", [b"a", b"b", b"c"])
+        assert hits[0] and not hits[1] and hits[2]
+    finally:
+        c2.close()
+        srv2.stop(grace=None)
+
+
+def test_topk_checkpoint_round_trip_carries_heap(tmp_path):
+    def build(c):
+        c.topk_reserve("tk", 2, width=64, depth=4)
+        c.topk_add("tk", [b"hot"] * 4 + [b"warm"] * 2 + [b"cold"])
+        c.checkpoint("tk", wait=True)
+
+    svc2, srv2, c2 = _restart_with_sink(tmp_path, build)
+    try:
+        c2.topk_reserve("tk", 2, width=64, depth=4)
+        items = c2.topk_list("tk")
+        assert [k for k, _ in items] == [b"hot", b"warm"]
+        assert items[0][1] >= 4
+        # the counter grid restored too, not just the heap
+        est = c2.cms_query("tk", [b"hot"])
+        assert est[0] >= 4
+    finally:
+        c2.close()
+        srv2.stop(grace=None)
+
+
+def test_checkpoint_blob_round_trip_and_kind_guard():
+    """snapshot_blob/restore_blob per kind, and a blob must refuse to
+    hydrate into a config of a different kind."""
+    cf = _cuckoo(m=64)
+    cf.insert_batch([b"x"])
+    cf.take_insert_flags()
+    _, _, blob = ckpt.snapshot_blob(cf)
+    header, _ = ckpt._deserialize(blob)
+    assert header["format"] == "sketch_cuckoo_le_words"
+    restored = ckpt.restore_blob(blob)
+    assert isinstance(restored, CuckooFilter)
+    assert restored.include_batch([b"x"])[0]
+    with pytest.raises(ValueError, match="mismatch"):
+        ckpt.restore_blob(
+            blob, FilterConfig(m=64, k=4, kind="cms", key_name="cf")
+        )
+    # cms + topk blobs round-trip too (heap via the extra block)
+    tk = _topk(topk=2)
+    tk.insert_batch([b"hot", b"hot", b"cold"])
+    _, _, tblob = ckpt.snapshot_blob(tk)
+    theader, _ = ckpt._deserialize(tblob)
+    assert theader["format"] == "sketch_topk_le_words"
+    trestored = ckpt.restore_blob(tblob)
+    assert isinstance(trestored, TopKSketch)
+    assert trestored.topk_list() == tk.topk_list()
+
+
+# -- inherited planes: coalescer / replication / migration -------------------
+
+
+def test_sketch_kinds_ride_the_coalescer_with_keys_fixed(tmp_path):
+    """Concurrent fixed-width batches coalesce into shared flushes;
+    per-request demux returns each caller's own verdicts (cuckoo FULL
+    slices per entry), and the registry's replay-unsafe classification
+    keeps rid dedup armed on the coalesced path."""
+    import threading
+
+    svc = BloomService(coalesce=CoalesceConfig(max_keys=4096, max_wait_us=2000))
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        with BloomClient(addr) as admin:
+            admin.cf_reserve("cf", 100_000)
+            admin.cms_init_by_dim("cms", 2048, 4)
+
+            errs = []
+
+            def writer(t):
+                try:
+                    with BloomClient(addr) as c:
+                        for i in range(4):
+                            ks = np.arange(t * 1000 + i * 100,
+                                           t * 1000 + i * 100 + 50,
+                                           dtype=np.uint64)
+                            assert c.cf_add("cf", ks).all()
+                            c.cms_incrby("cms", ks)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, errs[:1]
+
+            counters = admin.stats()["counters"]
+            assert counters.get("ingest_requests_coalesced", 0) >= 1, (
+                "sketch batches must ride the coalescer, not fall back"
+            )
+            for t in range(4):
+                ks = np.arange(t * 1000, t * 1000 + 50, dtype=np.uint64)
+                assert admin.cf_exists("cf", ks).all()
+                assert (admin.cms_query("cms", ks) >= 1).all()
+    finally:
+        srv.stop(grace=None)
+
+
+def test_cuckoo_full_verdicts_demuxed_per_request(tmp_path):
+    """A tiny cuckoo table overfilled through the coalesced path: the
+    response's ``full`` bitmap flags exactly the rejected keys, and
+    accepted ones are queryable."""
+    svc = BloomService(coalesce=CoalesceConfig(max_keys=4096, max_wait_us=2000))
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    try:
+        with BloomClient(f"127.0.0.1:{port}") as c:
+            c.create_filter("cf", config={"kind": "cuckoo", "m": 64, "k": 2})
+            keys = [b"of-%03d" % i for i in range(128)]
+            added = c.cf_add("cf", keys)
+            assert not added.all() and added.any()
+            accepted = [k for k, ok in zip(keys, added) if ok]
+            assert c.cf_exists("cf", accepted).all()
+    finally:
+        srv.stop(grace=None)
+
+
+def test_sketch_replicates_under_min_replicas_quorum(tmp_path):
+    """cf_add / weighted CMSIncrBy under ``min_replicas=1``: the quorum
+    ack means the record IS applied on the replica — membership and
+    counts agree there."""
+    oplog = OpLog(str(tmp_path / "plog"))
+    psvc = BloomService(oplog=oplog)
+    psrv, pport = build_server(psvc, "127.0.0.1:0")
+    psrv.start()
+    psvc.listen_address = f"127.0.0.1:{pport}"
+    rsvc = BloomService(read_only=True)
+    rsrv, rport = build_server(rsvc, "127.0.0.1:0")
+    rsrv.start()
+    rsvc.listen_address = f"127.0.0.1:{rport}"
+    applier = ReplicaApplier(
+        rsvc, f"127.0.0.1:{pport}", reconnect_base=0.05,
+        listen_address=rsvc.listen_address,
+    ).start()
+    c = BloomClient(f"127.0.0.1:{pport}")
+    rc = BloomClient(f"127.0.0.1:{rport}")
+    try:
+        c.wait_ready()
+        c.cf_reserve("cf", 1000)
+        c.cms_init_by_dim("cms", 64, 4)
+        # warm the replica's first-apply jit compile outside the barrier
+        c.cf_add("cf", [b"warmup"])
+        assert applier.wait_for_seq(oplog.last_seq, 60), applier.status()
+
+        assert c.cf_add(
+            "cf", [b"r1", b"r2"], min_replicas=1,
+            min_replicas_timeout_ms=30_000,
+        ).all()
+        assert rc.cf_exists("cf", [b"r1", b"r2"]).all()
+
+        counts = c.cms_incrby("cms", [b"hh"], [7], min_replicas=1,
+                              min_replicas_timeout_ms=30_000)
+        assert counts[0] >= 7
+        assert rc.cms_query("cms", [b"hh"])[0] == counts[0], (
+            "weighted increments must replay with their exact weights"
+        )
+
+        # deletes replicate too (cuckoo one-copy semantics preserved)
+        assert c.cf_del("cf", [b"r1"], min_replicas=1,
+                        min_replicas_timeout_ms=30_000).all()
+        hits = rc.cf_exists("cf", [b"r1", b"r2"])
+        assert not hits[0] and hits[1]
+
+        # replica refuses sketch writes like any write; the client
+        # follows the READONLY redirect to the upstream primary
+        # (Redis-MOVED-style), so the write lands there instead
+        assert rc.cf_add("cf", [b"redirected"]).all()
+        assert rc.address == psvc.listen_address
+        assert c.cf_exists("cf", [b"redirected"])[0]
+    finally:
+        c.close()
+        rc.close()
+        applier.stop()
+        rsrv.stop(grace=None)
+        psrv.stop(grace=None)
+        oplog.close()
+
+
+def _cluster_node(tmp_path, name):
+    d = tmp_path / name
+    oplog = OpLog(str(d / "log"))
+    svc = BloomService(oplog=oplog)
+    srv, port = build_server(svc, "127.0.0.1:0")
+    srv.start()
+    addr = f"127.0.0.1:{port}"
+    svc.listen_address = addr
+    svc.cluster = ClusterState(addr, state_dir=str(d / "log"))
+    return svc, srv, addr, oplog
+
+
+def test_sketch_filters_migrate_via_migrate_slot(tmp_path):
+    """A cuckoo filter and a top-k sketch in one slot survive a
+    MigrateSlot handoff: membership, one-copy delete semantics and the
+    heavy-hitter heap all present at the target."""
+    a = _cluster_node(tmp_path, "a")
+    b = _cluster_node(tmp_path, "b")
+    try:
+        addrs = [a[2], b[2]]
+        ranges = even_ranges(addrs)
+        for svc, _srv, _addr, _ in (a, b):
+            svc.ClusterSetSlot({"assign": ranges, "epoch": 1})
+        # one hash-tagged pair so both filters share a slot owned by a
+        name_cf = name_tk = None
+        for i in range(4096):
+            tag = "{mig-%d}" % i
+            if a[0].cluster.owner(S.key_slot(tag)) == addrs[0]:
+                name_cf, name_tk = tag + "cf", tag + "tk"
+                break
+        assert name_cf is not None
+        slot = S.key_slot(name_cf)
+
+        ca = BloomClient(addrs[0])
+        ca.cf_reserve(name_cf, 1000)
+        ca.topk_reserve(name_tk, 2, width=64, depth=4)
+        assert ca.cf_add(name_cf, [b"m1", b"m2", b"m2"]).all()
+        ca.topk_add(name_tk, [b"hot"] * 4 + [b"cold"])
+
+        resp = ca.migrate_slot(slot, addrs[1])
+        assert resp["ok"] and resp["filters_moved"] >= 2
+        ca.close()
+
+        cb = BloomClient(addrs[1])
+        try:
+            assert cb.cf_exists(name_cf, [b"m1", b"m2"]).all()
+            # multiset copies moved intact: two deletes to empty m2
+            assert cb.cf_del(name_cf, [b"m2"])[0]
+            assert cb.cf_exists(name_cf, [b"m2"])[0]
+            assert cb.cf_del(name_cf, [b"m2"])[0]
+            assert not cb.cf_exists(name_cf, [b"m2"])[0]
+            items = cb.topk_list(name_tk)
+            assert items and items[0][0] == b"hot" and items[0][1] >= 4
+        finally:
+            cb.close()
+    finally:
+        for svc, srv, _addr, oplog in (a, b):
+            srv.stop(grace=None)
+            oplog.close()
+            if svc.cluster is not None:
+                svc.cluster.close()
+
+
+# -- fault points ------------------------------------------------------------
+
+
+def test_sketch_fault_points_fire():
+    faults.arm("cuckoo.kick", mode="raise")
+    f = _cuckoo()
+    with pytest.raises(faults.InjectedFault):
+        f.insert_batch([b"x"])
+    faults.reset()
+    faults.arm("cms.update", mode="raise")
+    g = _cms()
+    with pytest.raises(faults.InjectedFault):
+        g.insert_batch([b"x"])
+    with pytest.raises(faults.InjectedFault):
+        g.increment_batch([b"x"], [2])
+    faults.reset()
+    # disarmed: both paths run clean
+    f.insert_batch([b"x"])
+    g.insert_batch([b"x"])
+
+
+# -- tier-1 smoke over the sketch bench ---------------------------------------
+
+
+def test_sketch_bench_smoke():
+    """The sketch kinds must actually ride the coalescer on a live
+    subprocess server — merged flushes, honest presence, conserved CMS
+    mass (anti-gaming asserts inside run_load)."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks"),
+    )
+    import sketch_smoke
+
+    out = sketch_smoke.run_load(duration_s=1.5)
+    assert out["cf_requests_per_flush"] > sketch_smoke.FLUSH_GATE
+    assert out["cms_keys_incremented"] >= (
+        sketch_smoke.CONNECTIONS * sketch_smoke.BATCH
+    )

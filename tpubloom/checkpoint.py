@@ -59,6 +59,7 @@ import numpy as np
 from tpubloom import faults
 from tpubloom.config import FilterConfig, identity_mismatch
 from tpubloom.obs import counters as _counters
+from tpubloom.sketch import registry as sketch_registry
 from tpubloom.utils import locks
 from tpubloom.utils.crc32c import crc32c
 
@@ -102,7 +103,14 @@ def _serialize(
     """
     from tpubloom.utils.packing import words_to_redis_bitmap
 
-    if config.counting:
+    if sketch_registry.is_sketch(config):
+        # sketch kinds (ISSUE 19): flat uint32 storage (cuckoo slots /
+        # CMS counter grid) under the kind registry's blob tag, so a
+        # restore can refuse a blob whose layout disagrees with the
+        # config's kind
+        payload = words.reshape(-1).astype("<u4").tobytes()
+        fmt = sketch_registry.blob_format(config)
+    elif config.counting:
         payload = words.astype("<u4").tobytes()
         fmt = "counting_le_words"
     elif config.block_bits:
@@ -216,7 +224,9 @@ def _deserialize(data: bytes) -> Tuple[dict, bytes]:
 def payload_to_words(config: FilterConfig, header: dict, payload: bytes) -> np.ndarray:
     from tpubloom.utils.packing import redis_bitmap_to_words
 
-    if header["format"] in ("counting_le_words", "blocked_le_words"):
+    if header["format"] in ("counting_le_words", "blocked_le_words") or (
+        header["format"].startswith("sketch_")
+    ):
         return np.frombuffer(payload, dtype="<u4").astype(np.uint32)
     return redis_bitmap_to_words(payload, config.m)
 
@@ -578,11 +588,17 @@ def _device_snapshot(words):
 
 def _usage_extra(filter_obj) -> dict:
     """Usage counters recorded in every checkpoint so restore can rebuild
-    server stats."""
-    return {
+    server stats — plus any kind-specific host-side state the filter
+    declares (ISSUE 19: the top-k heavy-hitter heap rides here; the
+    counter grid alone can't name which keys are hot)."""
+    out = {
         "n_inserted": getattr(filter_obj, "n_inserted", 0),
         "n_queried": getattr(filter_obj, "n_queried", 0),
     }
+    sketch_extra = getattr(filter_obj, "sketch_extra", None)
+    if sketch_extra is not None:
+        out.update(sketch_extra())
+    return out
 
 
 def snapshot_blob(
@@ -794,7 +810,25 @@ def _build_filter(
             f"requested={getattr(config, field)}"
         )
     words = payload_to_words(config, header, payload)
-    if config.shards > 1:
+    if sketch_registry.is_sketch(config):
+        # sketch kinds restore through the SAME registry factory
+        # CreateFilter builds with; the blob tag must agree with the
+        # config's kind (identity_mismatch above already rejects a kind
+        # flip, this guards a mislabeled/corrupted payload tag)
+        import jax.numpy as jnp
+
+        expect_fmt = sketch_registry.blob_format(config)
+        if header["format"] != expect_fmt:
+            raise ValueError(
+                f"checkpoint payload tag {header['format']!r} does not "
+                f"match kind {config.kind!r} (want {expect_fmt!r})"
+            )
+        f = sketch_registry.build(config)
+        f.words = jnp.asarray(words.reshape(f.words.shape))
+        loader = getattr(f, "load_sketch_extra", None)
+        if loader is not None:
+            loader(header.get("extra", {}))
+    elif config.shards > 1:
         from tpubloom.parallel.sharded import ShardedBloomFilter
         import jax
 

@@ -65,6 +65,18 @@ KEYED_METHODS = frozenset(
         "Clear",
         "Stats",
         "Checkpoint",
+        # sketch-plane verbs (ISSUE 19) are keyed like their bloom
+        # counterparts — same slot routing, same MOVED/ASK machinery
+        "CFReserve",
+        "CFAdd",
+        "CFDel",
+        "CFExists",
+        "CMSInitByDim",
+        "CMSIncrBy",
+        "CMSQuery",
+        "TopKReserve",
+        "TopKAdd",
+        "TopKList",
     }
 )
 

@@ -24,8 +24,14 @@ DEFAULT_SEED = 0x9747B28C
 #: between identical hash configs; shards is identity-relevant because the
 #: sharded payload is shard-major with per-shard-local positions).
 IDENTITY_FIELDS = (
-    "m", "k", "seed", "counting", "shards", "block_bits", "block_hash"
+    "m", "k", "seed", "counting", "shards", "block_bits", "block_hash",
+    "kind", "topk",
 )
+
+#: Filter kinds with their own storage layout + kernels (ISSUE 19).
+#: "bloom" covers the whole pre-existing family (plain/counting/blocked/
+#: sharded/scalable); the sketch kinds plug in via tpubloom.sketch.registry.
+FILTER_KINDS = ("bloom", "cuckoo", "cms", "topk")
 
 
 def identity_mismatch(a, b, fields=IDENTITY_FIELDS):
@@ -122,8 +128,35 @@ class FilterConfig:
     insert_path: str = "auto"
     query_path: str = "auto"
     block_hash: str = "auto"
+    #: Filter kind (ISSUE 19): "bloom" (the whole pre-existing family),
+    #: "cuckoo" (m = fingerprint slots, k = candidate buckets per key),
+    #: "cms" (m = row width in counters, k = rows), or "topk" (a CMS that
+    #: additionally maintains a host-side top-`topk` heavy-hitter heap).
+    #: Part of the filter's identity — storage layouts are incompatible.
+    kind: str = "bloom"
+    #: Heavy-hitter heap size; required > 0 for kind="topk", 0 otherwise.
+    topk: int = 0
 
     def __post_init__(self) -> None:
+        if self.kind not in FILTER_KINDS:
+            raise ValueError(f"kind must be one of {FILTER_KINDS}, got {self.kind!r}")
+        if self.kind != "bloom":
+            # sketch kinds own their storage layout; the bloom-family
+            # layout options are meaningless (and unimplemented) for them
+            if self.counting or self.block_bits or self.shards != 1:
+                raise ValueError(
+                    f"kind={self.kind!r} does not combine with counting/"
+                    "block_bits/shards — those are bloom-family layouts"
+                )
+            if self.kind == "cuckoo" and not (self.m & (self.m - 1)) == 0:
+                raise ValueError(
+                    f"cuckoo filters need a power-of-two slot count m, got {self.m}"
+                )
+        if self.kind == "topk":
+            if self.topk <= 0:
+                raise ValueError("kind='topk' requires topk > 0")
+        elif self.topk:
+            raise ValueError(f"topk is only meaningful for kind='topk', got {self.topk}")
         if self.m <= 0:
             raise ValueError(f"m must be positive, got {self.m}")
         if not self.m_is_pow2 and self.m >= (1 << 31):

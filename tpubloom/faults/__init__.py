@@ -116,6 +116,8 @@ KNOWN_POINTS = {
     "ingest.flush",
     "stream.recv",
     "stream.ack",
+    "cuckoo.kick",
+    "cms.update",
     "storage.evict",
     "storage.hydrate",
     "shard.insert",

@@ -53,6 +53,9 @@ COUNTERS = (
     "cluster_migrate_tail_records",
     "cluster_migrations_completed",
     "cluster_moved_redirects",
+    "cms_keys_incremented",
+    "cuckoo_full_rejections",
+    "cuckoo_kicks_total",
     "delete_dedup_hits",
     "faults_injected",
     "filters_created",
@@ -124,9 +127,11 @@ COUNTERS = (
     "storage_hydrations_total",
     "storage_warm_demotions",
     "stream_acks_total",
+    "stream_credit_shrinks",
     "stream_credit_throttles",
     "stream_frame_dedup_hits",
     "stream_frames_total",
+    "topk_heap_updates",
     "trace_requests_sampled",
     "trace_spans_recorded",
 )
@@ -276,6 +281,12 @@ SPAN_DYNAMIC_PREFIXES = (
 #:   addr) — an aircraft recorder logs power-on; with the black box
 #:   (ISSUE 16) every state dir's ring carries at least this, so a
 #:   post-mortem can anchor "which process wrote these final events"
+#: * ``stream``         — a bidi ingest stream's lifecycle (ISSUE 19
+#:   satellite): ``phase=connect`` on open, ``phase=kill`` when the
+#:   transport/fault path breaks the stream mid-flight, and
+#:   ``phase=replay`` when a reconnected client's re-sent frame is
+#:   answered from the rid-dedup cache — the three beats a post-mortem
+#:   needs to see exactly-once replay actually happen
 EVENTS = (
     "shed",
     "breaker",
@@ -287,6 +298,7 @@ EVENTS = (
     "oplog_failstop",
     "drain",
     "boot",
+    "stream",
 )
 
 #: Shapes of names minted at runtime (not literal-checkable): the

@@ -1,0 +1,75 @@
+"""Count-min sketch kernels (ISSUE 19).
+
+A count-min sketch (Cormode & Muthukrishnan '05) is a ``[depth, width]``
+counter grid: each key hashes to one counter per row; increment adds to
+all ``depth`` counters, estimate takes their min. Estimates only ever
+OVER-count (every counter a key touches also absorbs other keys'
+increments), with error ≤ ``e/width * N`` at confidence ``1 - e^-depth``
+for N total increments.
+
+Position derivation reuses the bloom family's row machinery wholesale:
+``hashing.positions(m=width, k=depth)`` — the exact double-hashing spec
+every other kind uses, so the hash kernels, tests, and the Ruby parity
+story stay single-source. Storage is the FLAT ``uint32[depth * width]``
+array (row-major), the same 1-D uint32 shape the checkpoint/replication
+planes already move around; counters saturate at 2^32-1 in the sense
+that wraparound is the caller's capacity-planning problem (4 billion
+increments per cell), as with Redis' CMS.
+
+The update is ONE scatter-add over the flat array — ``.at[idx].add``
+has accumulating semantics for duplicate indices, so intra-batch
+duplicate keys (and row collisions between keys) are handled natively
+with no sort/segment pass. The estimate is one gather + row-min.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpubloom.ops import hashing
+
+
+def cms_positions(keys, lengths, *, width: int, depth: int, seed: int):
+    """Per-row counter positions: uint32[..., depth] in [0, width).
+
+    Thin wrapper over the shared :func:`tpubloom.ops.hashing.positions`
+    spec with m=width, k=depth. width < 2^31 always holds for sketches,
+    so the low word carries the whole position.
+    """
+    _, pos_lo = hashing.positions(keys, lengths, m=width, k=depth, seed=seed)
+    return pos_lo
+
+
+def _flat_indices(words, pos):
+    """[B, depth] flat row-major indices into the [depth*width] array."""
+    depth = pos.shape[-1]
+    width = words.shape[0] // depth
+    row_off = (jnp.arange(depth, dtype=jnp.uint32) * jnp.uint32(width))[None, :]
+    return (row_off + pos).astype(jnp.int32)
+
+
+@jax.jit
+def cms_update(words, pos, valid, increments):
+    """Scatter-add ``increments`` into every row's counter.
+
+    Args:
+      words: uint32[depth*width] flat counter grid.
+      pos: uint32[B, depth] from :func:`cms_positions`.
+      valid: bool[B] lane mask.
+      increments: uint32[B] per-key deltas.
+
+    Returns the updated flat grid.
+    """
+    flat = _flat_indices(words, pos).reshape(-1)
+    inc = jnp.where(valid, increments, jnp.uint32(0))
+    inc = jnp.broadcast_to(inc[:, None], pos.shape).reshape(-1)
+    return words.at[flat].add(inc)
+
+
+@jax.jit
+def cms_estimate(words, pos, valid):
+    """Point estimate per key: min over its row counters. uint32[B]."""
+    vals = words[_flat_indices(words, pos)]  # [B, depth] gather
+    est = vals.min(axis=-1)
+    return jnp.where(valid, est, jnp.uint32(0))

@@ -437,6 +437,11 @@ class ReplicaApplier:
                     )
             except Exception:
                 log.exception("replication apply failed; reconnecting")
+                # ISSUE 19 satellite: a replica that cannot apply what
+                # its primary sent is a fail-stop in miniature — freeze
+                # both black-box rings NOW, before minutes of reconnect
+                # churn lap the records that explain the bad apply
+                obs_blackbox.snapshot_rings("replica-failstop")
             finally:
                 with self._call_lock:
                     self._call = None

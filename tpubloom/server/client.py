@@ -332,6 +332,15 @@ class StreamSession:
                         self._credit = max(1, int(frame.get("credit") or 1))
                         self._cond.notify_all()
                     continue
+                if kind == "credit":
+                    # server-initiated shrink on an idle stream (ISSUE
+                    # 19 satellite): adopt the tighter window so the
+                    # next burst can't overrun a coalescer other
+                    # streams filled while this one sent nothing
+                    with self._cond:
+                        self._credit = max(1, int(frame.get("credit") or 1))
+                        self._cond.notify_all()
+                    continue
                 if kind != "ack":
                     continue
                 resp = frame.get("resp") or {}
@@ -1350,6 +1359,158 @@ class BloomClient:
 
     def checkpoint(self, name: str, *, wait: bool = True) -> dict:
         return self._rpc("Checkpoint", {"name": name, "wait": wait})
+
+    # -- sketch plane (ISSUE 19): cuckoo / count-min / top-k -----------------
+
+    def _remember_sketch_creation(self, name: str, resp: dict) -> None:
+        """Sketch reserves heal like bloom creations: remember the
+        server-adopted config so the NOT_FOUND heal can replay it."""
+        if isinstance(resp.get("config"), dict):
+            self._creations[name] = {"name": name, "config": resp["config"]}
+
+    def cf_reserve(
+        self, name: str, capacity: int, *, exist_ok: bool = False, **options
+    ) -> dict:
+        """Create a cuckoo filter sized for ``capacity`` keys
+        (RedisBloom ``CF.RESERVE``)."""
+        req: dict = {
+            "name": name, "capacity": int(capacity), "exist_ok": exist_ok,
+        }
+        if options:
+            req["options"] = options
+        resp = self._rpc("CFReserve", req)
+        self._remember_sketch_creation(name, resp)
+        return resp
+
+    def cf_add(
+        self,
+        name: str,
+        keys,
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> np.ndarray:
+        """Add keys to a cuckoo filter. Returns a bool array: True per
+        key that landed, False per key the (honestly) FULL table
+        rejected — unlike a bloom filter, a cuckoo filter refuses
+        rather than silently degrade its FPR."""
+        req = self._durability(
+            self._encode_keys({"name": name}, keys),
+            min_replicas, min_replicas_timeout_ms,
+        )
+        resp = self._rpc("CFAdd", req)
+        if "full" in resp:
+            return ~self._unpack_bool(resp, "full")
+        return np.ones(int(resp["n"]), dtype=bool)
+
+    def cf_del(
+        self,
+        name: str,
+        keys,
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> np.ndarray:
+        """Delete ONE stored copy per key from a cuckoo filter
+        (``CF.DEL``). Returns per-key bools: True where a copy
+        existed and was removed. Retries reuse the rid; the dedup
+        cache absorbs replays, so no double-remove."""
+        req = self._durability(
+            {"name": name, "keys": self._keys(keys)},
+            min_replicas, min_replicas_timeout_ms,
+        )
+        return self._unpack_bool(self._rpc("CFDel", req), "deleted")
+
+    def cf_exists(self, name: str, keys) -> np.ndarray:
+        """Cuckoo membership (``CF.EXISTS``, batched) — no false
+        negatives; false-positive rate bounded by the fingerprint."""
+        resp = self._rpc(
+            "CFExists", self._encode_keys({"name": name}, keys)
+        )
+        return self._unpack_bool(resp, "hits")
+
+    def cms_init_by_dim(
+        self, name: str, width: int, depth: int, *,
+        exist_ok: bool = False, **options,
+    ) -> dict:
+        """Create a count-min sketch (``CMS.INITBYDIM``); width rounds
+        up to a multiple of 32 (error bound only tightens)."""
+        req: dict = {
+            "name": name, "width": int(width), "depth": int(depth),
+            "exist_ok": exist_ok,
+        }
+        if options:
+            req["options"] = options
+        resp = self._rpc("CMSInitByDim", req)
+        self._remember_sketch_creation(name, resp)
+        return resp
+
+    def cms_incrby(
+        self,
+        name: str,
+        keys,
+        increments: Optional[Sequence[int]] = None,
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> Optional[list]:
+        """Increment key counts (``CMS.INCRBY``). Weighted increments
+        return the post-update estimates; unit increments (or None)
+        ride the coalesced insert path and return None — follow with
+        :meth:`cms_query` when you need the counts."""
+        req = self._durability(
+            {"name": name, "keys": self._keys(keys)},
+            min_replicas, min_replicas_timeout_ms,
+        )
+        if increments is not None:
+            req["increments"] = [int(i) for i in increments]
+        resp = self._rpc("CMSIncrBy", req)
+        counts = resp.get("counts")
+        return [int(c) for c in counts] if counts is not None else None
+
+    def cms_query(self, name: str, keys) -> np.ndarray:
+        """Point estimates (``CMS.QUERY``) — each only ever >= the
+        true count."""
+        resp = self._rpc(
+            "CMSQuery", {"name": name, "keys": self._keys(keys)}
+        )
+        return np.asarray(resp["counts"], dtype=np.uint32)
+
+    def topk_reserve(
+        self, name: str, topk: int, *, width: int = 2048, depth: int = 5,
+        exist_ok: bool = False, **options,
+    ) -> dict:
+        """Create a top-``topk`` heavy-hitter sketch (``TOPK.RESERVE``)."""
+        req: dict = {
+            "name": name, "topk": int(topk), "width": int(width),
+            "depth": int(depth), "exist_ok": exist_ok,
+        }
+        if options:
+            req["options"] = options
+        resp = self._rpc("TopKReserve", req)
+        self._remember_sketch_creation(name, resp)
+        return resp
+
+    def topk_add(
+        self,
+        name: str,
+        keys,
+        *,
+        min_replicas: Optional[int] = None,
+        min_replicas_timeout_ms: Optional[int] = None,
+    ) -> int:
+        """Count occurrences into a top-k sketch (``TOPK.ADD``)."""
+        req = self._durability(
+            self._encode_keys({"name": name}, keys),
+            min_replicas, min_replicas_timeout_ms,
+        )
+        return int(self._rpc("TopKAdd", req)["n"])
+
+    def topk_list(self, name: str) -> list:
+        """Current heavy hitters as ``(key_bytes, estimate)`` pairs,
+        estimate-descending (``TOPK.LIST WITHCOUNT``)."""
+        resp = self._rpc("TopKList", {"name": name})
+        return [(item["key"], int(item["count"])) for item in resp["items"]]
 
     # -- high availability (ISSUE 4) -----------------------------------------
 

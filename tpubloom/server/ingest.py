@@ -73,6 +73,7 @@ from tpubloom.obs import context as obs
 from tpubloom.obs import counters as obs_counters
 from tpubloom.obs import trace as obs_trace
 from tpubloom.ops.sweep import InFlight
+from tpubloom.sketch import registry as sketch_registry
 from tpubloom.utils import locks
 
 log = logging.getLogger("tpubloom.server")
@@ -725,6 +726,22 @@ class IngestCoalescer:
                     klist = keys if keys is not None else _rows_to_list(rows)
                     mf.filter.insert_batch(klist)
                     out = None
+                # honest-FULL verdicts (ISSUE 19): cuckoo inserts can
+                # reject; collect the per-key flags under the lock —
+                # they are per-launch state the NEXT flush would clobber
+                # (for a staged launch this fences it early; cuckoo's
+                # kick chain is sequential anyway, and honesty beats
+                # overlap). Rejected keys still ride the logged record:
+                # the kernels are deterministic, so a replica / crash
+                # replay rejects the exact same keys.
+                full = None
+                taker = getattr(mf.filter, "take_insert_flags", None)
+                if taker is not None:
+                    flags = taker()
+                    if flags is not None and not flags.all():
+                        full = ~np.asarray(flags, dtype=bool)
+                        if out is not None:
+                            out = None  # already fenced by the flag read
                 # ONE op-log append covers the whole flush (log before
                 # notify — the PR-3 ordering rule)
                 logged: dict = {"name": name}
@@ -752,7 +769,7 @@ class IngestCoalescer:
             presence = np.asarray(presence)  # fence + D2H, outside the lock
 
         def finalize():
-            self._finalize_insert(entries, seq, presence, ftrace)
+            self._finalize_insert(entries, seq, presence, ftrace, full=full)
 
         payload = (entries, finalize, self._needs_barrier(entries, seq))
         if out is not None:
@@ -787,7 +804,14 @@ class IngestCoalescer:
             else:
                 fallback = False
                 klist = keys if keys is not None else _rows_to_list(rows)
-                mf.filter.delete_batch(klist)
+                dout = mf.filter.delete_batch(klist)
+                deleted = None
+                if dout is not None and sketch_registry.is_sketch(
+                    mf.filter.config
+                ):
+                    # cuckoo per-key "a stored copy existed" verdicts,
+                    # demuxed back to each parked request like presence
+                    deleted = np.asarray(dout, dtype=bool)
                 logged: dict = {"name": name}
                 if rows is not None:
                     logged["keys_fixed"] = {
@@ -805,7 +829,7 @@ class IngestCoalescer:
         service.metrics.count("keys_deleted", sum(e.nkeys for e in entries))
 
         def finalize():
-            self._finalize_insert(entries, seq, None, ftrace)
+            self._finalize_insert(entries, seq, None, ftrace, deleted=deleted)
 
         self._settle((entries, finalize, self._needs_barrier(entries, seq)), None)
 
@@ -894,17 +918,22 @@ class IngestCoalescer:
         with self._cond:
             self._cond.notify_all()
 
-    def _finalize_insert(self, entries, seq, presence, ftrace=None) -> None:
+    def _finalize_insert(
+        self, entries, seq, presence, ftrace=None, full=None, deleted=None
+    ) -> None:
         """Demux one applied flush back to its parked requests: dedup
-        caching, presence slices, and ONE commit barrier whose achieved
-        count settles every request's own quorum. Self-protective: any
-        unexpected error completes EVERY still-parked entry (a finalize
-        may run from the double-buffer path, outside the run loop's
-        per-flush catch — waiters must never hang)."""
+        caching, presence/full/deleted slices, and ONE commit barrier
+        whose achieved count settles every request's own quorum.
+        Self-protective: any unexpected error completes EVERY
+        still-parked entry (a finalize may run from the double-buffer
+        path, outside the run loop's per-flush catch — waiters must
+        never hang)."""
         from tpubloom.server import protocol
 
         try:
-            self._finalize_insert_inner(entries, seq, presence, ftrace)
+            self._finalize_insert_inner(
+                entries, seq, presence, ftrace, full=full, deleted=deleted
+            )
         except BaseException as e:  # noqa: BLE001 — waiters must wake
             log.exception("ingest finalize failed")
             err = (
@@ -917,7 +946,9 @@ class IngestCoalescer:
                 if not entry.event.is_set():
                     entry.complete(error=err)
 
-    def _finalize_insert_inner(self, entries, seq, presence, ftrace=None) -> None:
+    def _finalize_insert_inner(
+        self, entries, seq, presence, ftrace=None, full=None, deleted=None
+    ) -> None:
         from tpubloom.server import protocol
 
         service = self._service
@@ -930,6 +961,15 @@ class IngestCoalescer:
             if entry.want_presence and presence is not None:
                 span = presence[off: off + entry.nkeys]
                 resp["presence"] = np.packbits(span).tobytes()
+            if full is not None:
+                span = full[off: off + entry.nkeys]
+                if span.any():  # same shape as the direct path: "full"
+                    # is present iff this request had rejected keys
+                    resp["full"] = np.packbits(span).tobytes()
+            if deleted is not None:
+                resp["deleted"] = np.packbits(
+                    deleted[off: off + entry.nkeys]
+                ).tobytes()
             off += entry.nkeys
             if entry.replay_unsafe:
                 # cache the CLEAN response (no barrier verdict): a

@@ -72,6 +72,20 @@ METHODS = (
     "ClusterSetSlot",
     "MigrateSlot",
     "MigrateInstall",
+    # sketch plane (ISSUE 19 — RedisBloom CF.*/CMS.*/TOPK.* parity).
+    # Reserve verbs are CreateFilter with a kind-specific geometry;
+    # Add/Del/Exists ride the bloom data-plane machinery (coalescer,
+    # dedup, quorum barriers, MOVED/ASK) via delegation in the service.
+    "CFReserve",
+    "CFAdd",
+    "CFDel",
+    "CFExists",
+    "CMSInitByDim",
+    "CMSIncrBy",
+    "CMSQuery",
+    "TopKReserve",
+    "TopKAdd",
+    "TopKList",
 )
 
 #: Server-streaming RPCs (ISSUE 3): each response frame is one msgpack
@@ -149,7 +163,22 @@ BIDI_STREAM_METHODS = (
 #: (ISSUE 4): a server whose epoch is newer answers ``STALE_EPOCH`` so
 #: topology-aware clients refresh instead of writing under a stale view.
 MUTATING_METHODS = frozenset(
-    {"CreateFilter", "DropFilter", "InsertBatch", "DeleteBatch", "Clear"}
+    {
+        "CreateFilter",
+        "DropFilter",
+        "InsertBatch",
+        "DeleteBatch",
+        "Clear",
+        # sketch-plane writes (ISSUE 19); the read verbs
+        # (CFExists/CMSQuery/TopKList) stay replica-servable
+        "CFReserve",
+        "CFAdd",
+        "CFDel",
+        "CMSInitByDim",
+        "CMSIncrBy",
+        "TopKReserve",
+        "TopKAdd",
+    }
 )
 
 #: Durability-gate RPC (ISSUE 5, Redis ``WAIT`` parity): ``Wait``

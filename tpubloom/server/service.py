@@ -151,6 +151,7 @@ from tpubloom.obs import blackbox as obs_blackbox
 from tpubloom.obs import flight as obs_flight
 from tpubloom.obs import trace as obs_trace
 from tpubloom.obs.slowlog import Slowlog, summarize_request
+from tpubloom.params import round_up_pow2
 from tpubloom.cluster import migrate as cluster_migrate
 from tpubloom.cluster import node as cluster_node
 from tpubloom.cluster import slots as cluster_slots
@@ -159,6 +160,7 @@ from tpubloom.repl import primary as repl_primary
 from tpubloom.repl.replica import FullResyncNeeded
 from tpubloom.server import protocol
 from tpubloom.server import streams as server_streams
+from tpubloom.sketch import registry as sketch_registry
 from tpubloom.server.metrics import Metrics
 from tpubloom.utils import locks, tracing
 
@@ -979,6 +981,10 @@ class BloomService:
             # msync the black box too (ISSUE 16): SIGKILL-safety needs
             # nothing, but a fail-stop may precede a machine going down
             obs_blackbox.sync()
+            # and freeze the rings (ISSUE 19 satellite): the ring is an
+            # overwrite buffer — if the process limps on serving reads,
+            # healthy traffic would lap the lead-up to the fail-stop
+            obs_blackbox.snapshot_rings("oplog-failstop")
             raise
         if mf is not None:
             mf.applied_seq = seq
@@ -1596,6 +1602,11 @@ class BloomService:
                     raise protocol.BloomServiceError("CKPT_MISMATCH", str(e))
             if restored is not None:
                 filt = restored
+            elif sketch_registry.is_sketch(config):
+                # sketch kinds (ISSUE 19) construct through the kind
+                # registry — the same factory checkpoint._build_filter
+                # restores through, so the two can never diverge
+                filt = sketch_registry.build(config)
             elif config.shards > 1:
                 # handles flat/blocked x plain/counting layouts (the same
                 # routing order as checkpoint.restore — the two MUST agree
@@ -1962,11 +1973,14 @@ class BloomService:
         scalable filters double-count layer fill, and a presence replay
         reports the batch's own keys as pre-existing. These answer
         retries from the rid cache instead (ISSUE 3 satellite — the same
-        machinery that makes DeleteBatch retryable)."""
+        machinery that makes DeleteBatch retryable). Sketch kinds carry
+        their own classification in the kind registry (ISSUE 19):
+        multiset cuckoo adds and CMS increments both corrupt on replay."""
         return bool(
             want_presence
             or getattr(mf.filter.config, "counting", False)
             or hasattr(mf.filter, "layers")
+            or sketch_registry.replay_unsafe_insert(mf.filter.config)
         )
 
     def InsertBatch(self, req: dict) -> dict:
@@ -2009,6 +2023,10 @@ class BloomService:
                 mf.filter.insert_packed(rows)
             else:
                 mf.filter.insert_batch(self._keys_list(req))
+            # honest-FULL verdicts (ISSUE 19): a cuckoo insert can reject
+            # keys; collect the per-key flags under the op lock so the
+            # response never claims an insert the kernel refused
+            full = self._take_insert_full(mf)
             # log BEFORE notify_inserts: notify may trigger a checkpoint
             # whose snapshot contains this batch — its repl_seq stamp
             # (sampled from applied_seq at trigger time) must therefore
@@ -2028,9 +2046,24 @@ class BloomService:
             resp["repl_seq"] = seq
         if presence is not None:
             resp["presence"] = np.packbits(np.asarray(presence)).tobytes()
+        if full is not None:
+            resp["full"] = full
         if replay_unsafe:
             self._dedup_put(rid, resp)
         return resp
+
+    @staticmethod
+    def _take_insert_full(mf: _Managed):
+        """Packed not-inserted bitmap of the filter's last insert, or
+        None for kinds whose inserts cannot fail. MUST run under the op
+        lock, right after the insert — the flags are per-launch state."""
+        taker = getattr(mf.filter, "take_insert_flags", None)
+        if taker is None:
+            return None
+        flags = taker()
+        if flags is None or flags.all():
+            return None
+        return np.packbits(~np.asarray(flags, dtype=bool)).tobytes()
 
     def QueryBatch(self, req: dict) -> dict:
         mf = self._get(req["name"])
@@ -2077,12 +2110,16 @@ class BloomService:
         mf = self._get(req["name"])
         # attribute presence is not the signal (ShardedBloomFilter carries
         # delete_batch for all layouts and raises on non-counting): the
-        # config decides, and non-counting filters stay code UNSUPPORTED
-        if not getattr(mf.filter.config, "counting", False) or not hasattr(
-            mf.filter, "delete_batch"
-        ):
+        # config decides — counting bloom filters and the sketch kinds
+        # whose registry row says supports_delete (cuckoo; a CMS cannot
+        # un-count) — and everything else stays code UNSUPPORTED
+        deletable = getattr(
+            mf.filter.config, "counting", False
+        ) or sketch_registry.supports_delete(mf.filter.config)
+        if not deletable or not hasattr(mf.filter, "delete_batch"):
             raise protocol.BloomServiceError(
-                "UNSUPPORTED", "delete requires a counting filter"
+                "UNSUPPORTED",
+                "delete requires a counting filter or a deletable kind (cuckoo)",
             )
         # Retry safety (ISSUE 2 satellite): a delete is a counter
         # DECREMENT — a replay of one that already landed would decrement
@@ -2108,7 +2145,7 @@ class BloomService:
                 return resp
         nkeys = protocol.batch_size(req)
         with self._op(req["name"], write=True) as mf:
-            mf.filter.delete_batch(self._keys_list(req))
+            out = mf.filter.delete_batch(self._keys_list(req))
             seq = self._log_op(
                 "DeleteBatch", {"name": req["name"], **self._op_keys(req)}, mf
             )
@@ -2116,6 +2153,11 @@ class BloomService:
             seq = getattr(self._apply_seq_hint, "seq", None)
         self.metrics.count("keys_deleted", nkeys)
         resp = {"ok": True, "n": nkeys}
+        if out is not None and sketch_registry.is_sketch(mf.filter.config):
+            # cuckoo reports per-key "a stored copy existed" (a False
+            # flags a delete of a never-added key — a contract violation
+            # worth surfacing, not masking)
+            resp["deleted"] = np.packbits(np.asarray(out, dtype=bool)).tobytes()
         if seq is not None:
             resp["repl_seq"] = seq
         self._dedup_put(rid, resp)
@@ -2134,6 +2176,183 @@ class BloomService:
         if seq is not None:
             resp["repl_seq"] = seq
         return resp
+
+    # -- sketch plane (ISSUE 19): RedisBloom CF.*/CMS.*/TOPK.* parity ----
+    #
+    # The *Reserve verbs are CreateFilter with a kind-specific geometry;
+    # the data verbs delegate to the bloom data-plane handlers after a
+    # kind check, so coalescing, rid dedup, quorum barriers, READONLY,
+    # STALE_EPOCH, MOVED/ASK, replication, and tracing are inherited —
+    # never re-implemented per kind.
+
+    def _kind_checked(self, name: str, kinds: tuple, verb: str) -> _Managed:
+        """Resolve + type-check a filter for a kind-specific verb
+        (Redis WRONGTYPE parity: CF.ADD on a bloom key is an error)."""
+        mf = self._get(name)
+        kind = sketch_registry.kind_of(mf.filter.config)
+        if kind not in kinds:
+            raise protocol.BloomServiceError(
+                "WRONG_TYPE",
+                f"{verb} needs a {'/'.join(kinds)} filter; "
+                f"{name!r} is kind {kind!r}",
+            )
+        return mf
+
+    @staticmethod
+    def _sketch_create_req(req: dict, config: dict) -> dict:
+        """CreateFilter request for a reserve verb: the kind-specific
+        geometry plus the caller's durability/routing envelope (rid,
+        quorum, epoch, migration hints) passed through untouched."""
+        out = {
+            "name": req["name"],
+            "config": config,
+            "exist_ok": bool(req.get("exist_ok")),
+        }
+        if "restore" in req:
+            out["restore"] = req["restore"]
+        for field in ("rid", "min_replicas", "min_replicas_timeout_ms",
+                      "epoch", "asking", "src_seq"):
+            if field in req:
+                out[field] = req[field]
+        return out
+
+    def CFReserve(self, req: dict) -> dict:  # lint: allow(replay-safety): pure CreateFilter delegation — create replay converges (exist_ok attach / ALREADY_EXISTS), no per-key state to double-apply
+        """Create a cuckoo filter sized for ``capacity`` keys."""
+        capacity = int(req["capacity"])
+        if capacity <= 0:
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT", "capacity must be positive"
+            )
+        # size for ~84% slot load — the practical ceiling of a
+        # bucket-size-4 table before FULL rejections set in
+        slots = max(64, round_up_pow2(math.ceil(capacity / 0.84)))
+        config = {"kind": "cuckoo", "m": slots, "k": 2,
+                  **req.get("options", {})}
+        return self.CreateFilter(self._sketch_create_req(req, config))
+
+    def CFAdd(self, req: dict) -> dict:  # lint: allow(replay-safety): delegates to InsertBatch, which owns the rid-dedup cache (cuckoo inserts classify replay-unsafe via the kind registry)
+        """Add keys to a cuckoo filter; resp ``full`` flags rejects."""
+        self._kind_checked(req["name"], ("cuckoo",), "CFAdd")
+        return self.InsertBatch(req)
+
+    def CFDel(self, req: dict) -> dict:  # lint: allow(replay-safety): delegates to DeleteBatch, which owns the rid-dedup cache
+        """Delete one stored copy per key from a cuckoo filter."""
+        self._kind_checked(req["name"], ("cuckoo",), "CFDel")
+        return self.DeleteBatch(req)
+
+    def CFExists(self, req: dict) -> dict:
+        """Membership on a cuckoo filter (QueryBatch with a kind check)."""
+        self._kind_checked(req["name"], ("cuckoo",), "CFExists")
+        return self.QueryBatch(req)
+
+    def CMSInitByDim(self, req: dict) -> dict:  # lint: allow(replay-safety): pure CreateFilter delegation — see CFReserve
+        """Create a count-min sketch with explicit [depth, width] dims.
+        width rounds UP to a whole-uint32 multiple of 32 (strictly more
+        counters — the configured error bound stays an upper bound)."""
+        width, depth = int(req["width"]), int(req["depth"])
+        if width <= 0 or not (1 <= depth <= 64):
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT", "need width > 0 and depth in [1, 64]"
+            )
+        width = ((width + 31) // 32) * 32
+        config = {"kind": "cms", "m": width, "k": depth,
+                  **req.get("options", {})}
+        return self.CreateFilter(self._sketch_create_req(req, config))
+
+    def CMSIncrBy(self, req: dict) -> dict:
+        """Increment key counts. Unit increments (the common streaming
+        shape) ARE InsertBatch and ride the coalescer unmodified;
+        weighted increments take a direct pass that answers the
+        POST-update estimates (Redis CMS.INCRBY parity)."""
+        self._kind_checked(req["name"], ("cms", "topk"), "CMSIncrBy")
+        incs = req.get("increments")
+        nkeys = protocol.batch_size(req)
+        if incs is not None and len(incs) != nkeys:
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT", f"{len(incs)} increments for {nkeys} keys"
+            )
+        if incs is None or all(int(i) == 1 for i in incs):
+            return self.InsertBatch(
+                {k: v for k, v in req.items() if k != "increments"}
+            )
+        # weighted path: a replayed increment double-counts, so the rid
+        # cache answers retries (same contract as DeleteBatch)
+        rid = req.get("rid")
+        cached = self._dedup_get(rid)
+        if cached is not None:
+            self.metrics.count("insert_dedup_hits")
+            return cached
+        with self._op(req["name"], write=True) as mf, tracing.request_span(
+            "CMSIncrBy", batch=nkeys, rid=obs.current_rid()
+        ):
+            try:
+                counts = mf.filter.increment_batch(
+                    self._keys_list(req), [int(i) for i in incs]
+                )
+            except ValueError as e:
+                raise protocol.BloomServiceError("INVALID_ARGUMENT", str(e))
+            # log BEFORE notify_inserts — same checkpoint-stamp ordering
+            # as InsertBatch; the record carries the increments so a
+            # replica / crash replay re-applies the exact weights
+            seq = self._log_op(
+                "CMSIncrBy",
+                {"name": req["name"], **self._op_keys(req),
+                 "increments": [int(i) for i in incs]},
+                mf,
+            )
+            if seq is None:
+                seq = getattr(self._apply_seq_hint, "seq", None)
+            if mf.checkpointer:
+                mf.checkpointer.notify_inserts(nkeys)
+        self.metrics.count("keys_inserted", nkeys)
+        resp = {"ok": True, "n": nkeys, "counts": [int(c) for c in counts]}
+        if seq is not None:
+            resp["repl_seq"] = seq
+        self._dedup_put(rid, resp)
+        return resp
+
+    def CMSQuery(self, req: dict) -> dict:
+        """Point estimates (only ever >= the true count)."""
+        self._kind_checked(req["name"], ("cms", "topk"), "CMSQuery")
+        nkeys = protocol.batch_size(req)
+        with self._op(req["name"]) as mf, tracing.request_span(
+            "CMSQuery", batch=nkeys, rid=obs.current_rid()
+        ):
+            counts = mf.filter.estimate_batch(self._keys_list(req))
+        self.metrics.count("keys_queried", nkeys)
+        return {"ok": True, "n": nkeys, "counts": [int(c) for c in counts]}
+
+    def TopKReserve(self, req: dict) -> dict:  # lint: allow(replay-safety): pure CreateFilter delegation — see CFReserve
+        """Create a top-``topk`` heavy-hitter sketch (CMS-backed)."""
+        heap = int(req["topk"])
+        if heap <= 0:
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT", "topk must be positive"
+            )
+        width = ((int(req.get("width", 2048)) + 31) // 32) * 32
+        depth = int(req.get("depth", 5))
+        if width <= 0 or not (1 <= depth <= 64):
+            raise protocol.BloomServiceError(
+                "INVALID_ARGUMENT", "need width > 0 and depth in [1, 64]"
+            )
+        config = {"kind": "topk", "m": width, "k": depth, "topk": heap,
+                  **req.get("options", {})}
+        return self.CreateFilter(self._sketch_create_req(req, config))
+
+    def TopKAdd(self, req: dict) -> dict:  # lint: allow(replay-safety): delegates to InsertBatch, which owns the rid-dedup cache (topk inserts classify replay-unsafe via the kind registry)
+        """Count occurrences into a top-k sketch (unit increments)."""
+        self._kind_checked(req["name"], ("topk",), "TopKAdd")
+        return self.InsertBatch(req)
+
+    def TopKList(self, req: dict) -> dict:
+        """Current heavy hitters, estimate-descending."""
+        self._kind_checked(req["name"], ("topk",), "TopKList")
+        with self._op(req["name"]) as mf:
+            items = mf.filter.topk_list()
+        return {
+            "ok": True,
+            "items": [{"key": k, "count": c} for k, c in items],
+        }
 
     def Stats(self, req: dict) -> dict:
         if "name" in req:

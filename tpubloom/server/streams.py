@@ -64,6 +64,7 @@ from tpubloom.cluster import migrate as cluster_migrate
 from tpubloom.cluster import node as cluster_node
 from tpubloom.obs import context as obs
 from tpubloom.obs import counters as obs_counters
+from tpubloom.obs import flight as obs_flight
 from tpubloom.obs import trace as obs_trace
 from tpubloom.server import protocol
 from tpubloom.utils import locks
@@ -122,14 +123,21 @@ class _Stream:
         self.outq: "queue.Queue" = queue.Queue()
         self.cond = locks.named_condition("stream.state")
         self.pending = 0
+        #: last credit grant sent on any outbound frame — the baseline
+        #: the idle pump compares against before pushing a server-
+        #: initiated shrink frame (benign cross-thread race: a stale
+        #: read only costs one redundant frame or skips one)
+        self.last_credit = MAX_WINDOW
 
     def enqueue_ack(self, seq, resp: dict) -> None:
         """Build + encode one ack OUTSIDE every lock (credit reads the
         coalescer's queue condition) and hand it to the ack pump."""
+        grant = credit_grant(self.service)
+        self.last_credit = grant
         frame = {
             "kind": "ack",
             "seq": seq,
-            "credit": credit_grant(self.service),
+            "credit": grant,
             "resp": resp,
         }
         self.outq.put(protocol.encode(frame))
@@ -293,6 +301,10 @@ def _handle_frame(service, stream: _Stream, req: dict) -> None:
                     # from cache, re-waiting the barrier on the SAME
                     # record (direct-path dedup parity)
                     service.metrics.count("stream_frame_dedup_hits")
+                    obs_flight.note(
+                        "stream", phase="replay", method=method,
+                        rid=rid, seq=int(seq) if seq is not None else -1,
+                    )
                     try:
                         resp = service.commit_barrier(req, dict(cached))
                         if service.cluster is not None and resp.get("ok"):
@@ -380,11 +392,27 @@ def _receiver(service, stream: _Stream, request_iterator,
         stream.outq.put(None)
 
 
+#: how long the ack pump idles on an empty outbound queue before
+#: re-reading the coalescer's headroom — bounds how stale a client's
+#: credit window can get while it sends nothing
+IDLE_CREDIT_POLL_S = 0.25
+
+
 def _run_stream(service, method_name: str, request_iterator, context):
     """One bidi stream's lifetime: hello (initial credit), receiver
-    thread, ack pump, teardown accounting."""
+    thread, ack pump, teardown accounting.
+
+    The ack pump doubles as the idle credit refresher (ISSUE 19
+    satellite): acks piggyback fresh grants, but an IDLE stream has no
+    ack to ride — its client would happily burst a stale fat window
+    into a coalescer other streams have since filled. So when the
+    outbound queue stays empty for :data:`IDLE_CREDIT_POLL_S`, the pump
+    re-reads :func:`credit_grant` and pushes a server-initiated
+    ``{"kind": "credit"}`` frame IF the grant shrank (grow-only changes
+    wait for the next ack — only shrinks are urgent)."""
     stream = _Stream(service, FRAME_METHODS[method_name])
     _track_connected(+1)
+    obs_flight.note("stream", phase="connect", method=method_name)
     failure: list = []
     receiver = threading.Thread(
         target=_receiver,
@@ -393,18 +421,33 @@ def _run_stream(service, method_name: str, request_iterator, context):
         daemon=True,
     )
     try:
+        stream.last_credit = credit_grant(service)
         yield protocol.encode(
-            {"kind": "hello", "credit": credit_grant(service)}
+            {"kind": "hello", "credit": stream.last_credit}
         )
         receiver.start()
         while True:
-            item = stream.outq.get()
+            try:
+                item = stream.outq.get(timeout=IDLE_CREDIT_POLL_S)
+            except queue.Empty:
+                fresh = credit_grant(service)
+                if fresh < stream.last_credit:
+                    stream.last_credit = fresh
+                    obs_counters.incr("stream_credit_shrinks")
+                    yield protocol.encode(
+                        {"kind": "credit", "credit": fresh}
+                    )
+                continue
             if item is None:
                 break
             faults.fire("stream.ack")
             service.metrics.count("stream_acks_total")
             yield item
         if failure:
+            obs_flight.note(
+                "stream", phase="kill", method=method_name,
+                error=repr(failure[0]),
+            )
             raise failure[0]
     finally:
         _track_connected(-1)

@@ -1,0 +1,25 @@
+"""Sketch plane (ISSUE 19): non-bloom filter kinds as pluggable peers.
+
+``tpubloom.sketch`` hosts the filter kinds whose storage is NOT a bloom
+bit array — the cuckoo filter (true deletion without counters) and the
+count-min sketch / top-k heavy-hitter pair (frequency workloads). Each
+kind plugs into the serving stack through :mod:`tpubloom.sketch.registry`
+(factory + checkpoint blob tag + per-kind replay-safety classification),
+so replication, sync-quorum barriers, HA promotion, cluster migration,
+tenant paging, streaming ingest, and tracing are inherited from the
+shared planes — never re-implemented per kind. See the README "Filter
+kinds" section for the add-a-kind recipe and the lint checks that
+enforce each step.
+"""
+
+from tpubloom.sketch.registry import (  # noqa: F401
+    KindSpec,
+    blob_format,
+    build,
+    is_sketch,
+    kind_of,
+    replay_unsafe_insert,
+    sketch_kinds,
+    spec,
+    supports_delete,
+)

@@ -1,0 +1,142 @@
+"""Kind registry: the one table that makes filter kinds pluggable.
+
+Every serving-plane decision that must vary per kind is a column here,
+so "add a filter kind" is one row plus its kernels — not a grep through
+service/checkpoint/ingest for special cases:
+
+* ``factory`` — builds the in-memory filter from its ``FilterConfig``
+  (``CreateFilter`` routing and checkpoint restore both dispatch
+  through it, so the two can never disagree on construction).
+* ``blob_format`` — the checkpoint payload tag
+  (:mod:`tpubloom.checkpoint` round-trips the flat uint32 storage under
+  this name; restore refuses blobs whose tag doesn't match the config's
+  kind).
+* ``replay_unsafe_insert`` — whether replaying an acked insert changes
+  state (multiset cuckoo adds a second fingerprint copy; CMS doubles
+  counts). True routes the kind's inserts through the rid-dedup cache
+  exactly like counting/scalable bloom inserts, which is what makes the
+  per-kind SIGKILL chaos acceptances ("neither lost nor doubled") hold.
+* ``supports_delete`` — whether ``DeleteBatch``/``CFDel`` is legal
+  (cuckoo: yes, without 4-bit counters; CMS/top-k: no — a count-min
+  sketch cannot un-count).
+
+The ``"bloom"`` kind is deliberately NOT a row: the pre-existing family
+(plain/counting/blocked/sharded/scalable) keeps its own routing chain in
+``service._create`` / ``checkpoint._build_filter``, and the helpers here
+return the neutral answer (not sketch, replay-safety decided by the
+bloom-family rules) for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable
+
+__all__ = [
+    "KindSpec",
+    "blob_format",
+    "build",
+    "is_sketch",
+    "kind_of",
+    "replay_unsafe_insert",
+    "sketch_kinds",
+    "spec",
+    "supports_delete",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class KindSpec:
+    """One pluggable filter kind. ``factory`` is a ``module:Class``
+    dotted path resolved lazily (the sketch classes import jax kernels;
+    the registry must stay importable from config/analysis contexts)."""
+
+    name: str
+    factory: str
+    blob_format: str
+    replay_unsafe_insert: bool
+    supports_delete: bool
+
+    def resolve(self) -> Callable:
+        module, _, attr = self.factory.partition(":")
+        return getattr(importlib.import_module(module), attr)
+
+
+_SPECS = {
+    "cuckoo": KindSpec(
+        name="cuckoo",
+        factory="tpubloom.sketch.cuckoo:CuckooFilter",
+        blob_format="sketch_cuckoo_le_words",
+        replay_unsafe_insert=True,  # multiset adds: replay stores a 2nd copy
+        supports_delete=True,
+    ),
+    "cms": KindSpec(
+        name="cms",
+        factory="tpubloom.sketch.cms:CountMinSketch",
+        blob_format="sketch_cms_le_words",
+        replay_unsafe_insert=True,  # replayed increment doubles counts
+        supports_delete=False,
+    ),
+    "topk": KindSpec(
+        name="topk",
+        factory="tpubloom.sketch.cms:TopKSketch",
+        blob_format="sketch_topk_le_words",
+        replay_unsafe_insert=True,  # CMS-backed: same doubling hazard
+        supports_delete=False,
+    ),
+}
+
+
+def sketch_kinds() -> tuple:
+    """Registered sketch kinds (excludes "bloom")."""
+    return tuple(sorted(_SPECS))
+
+
+def kind_of(config) -> str:
+    """The kind of a FilterConfig or config dict ("bloom" when absent —
+    every header/record written before the field existed is bloom)."""
+    if isinstance(config, dict):
+        return config.get("kind") or "bloom"
+    return getattr(config, "kind", "bloom") or "bloom"
+
+
+def is_sketch(config) -> bool:
+    return kind_of(config) != "bloom"
+
+
+def spec(kind: str) -> KindSpec:
+    try:
+        return _SPECS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown filter kind {kind!r} (registered: {sketch_kinds()})"
+        ) from None
+
+
+def build(config):
+    """Construct the filter instance for a sketch-kind config."""
+    return spec(kind_of(config)).resolve()(config)
+
+
+def blob_format(config) -> str:
+    return spec(kind_of(config)).blob_format
+
+
+def replay_unsafe_insert(config) -> bool:
+    """Whether this kind's inserts must ride the rid-dedup cache.
+    False for "bloom" — the bloom family's own classification
+    (counting/scalable/presence) applies there."""
+    kind = kind_of(config)
+    if kind == "bloom":
+        return False
+    return spec(kind).replay_unsafe_insert
+
+
+def supports_delete(config) -> bool:
+    """Whether DeleteBatch is legal for this kind. False for "bloom" —
+    the counting-filter check applies there."""
+    kind = kind_of(config)
+    if kind == "bloom":
+        return False
+    return spec(kind).supports_delete
